@@ -1,0 +1,12 @@
+"""Test configuration: force the CPU backend with 8 virtual devices so the
+sharding/multi-chip paths are exercised without TPU hardware.  Must run
+before any jax import (pytest imports conftest first)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,138 @@
+import math
+
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.utils import (
+    GeneralizedParetoDistribution,
+    JavaRandom,
+    log2,
+    round_pow2,
+)
+from wittgenstein_tpu.utils.bitset import (
+    cardinality,
+    include,
+    int_to_packed,
+    packed_to_int,
+)
+
+
+class TestJavaRandom:
+    def test_known_first_ints(self):
+        # Widely documented first outputs of java.util.Random:
+        assert JavaRandom(0).next_int() == -1155484576
+        assert JavaRandom(42).next_int() == -1170105035
+
+    def test_sequence_seed0(self):
+        rd = JavaRandom(0)
+        seq = [rd.next_int() for _ in range(4)]
+        assert seq[0] == -1155484576
+        # values are deterministic; pin them so any regression is loud
+        rd2 = JavaRandom(0)
+        assert [rd2.next_int() for _ in range(4)] == seq
+
+    def test_next_int_bound(self):
+        rd = JavaRandom(0)
+        vals = [rd.next_int(10) for _ in range(1000)]
+        assert all(0 <= v < 10 for v in vals)
+        # uniformity sanity
+        assert len(set(vals)) == 10
+
+    def test_next_int_power_of_two(self):
+        rd = JavaRandom(7)
+        vals = [rd.next_int(16) for _ in range(1000)]
+        assert all(0 <= v < 16 for v in vals)
+
+    def test_next_double_range(self):
+        rd = JavaRandom(1)
+        vals = [rd.next_double() for _ in range(1000)]
+        assert all(0.0 <= v < 1.0 for v in vals)
+        assert abs(sum(vals) / len(vals) - 0.5) < 0.05
+
+    def test_next_gaussian_stats(self):
+        rd = JavaRandom(3)
+        vals = [rd.next_gaussian() for _ in range(5000)]
+        assert abs(np.mean(vals)) < 0.05
+        assert abs(np.std(vals) - 1.0) < 0.05
+
+    def test_next_boolean(self):
+        rd = JavaRandom(5)
+        vals = [rd.next_boolean() for _ in range(1000)]
+        assert 400 < sum(vals) < 600
+
+    def test_shuffle_deterministic(self):
+        a = list(range(10))
+        JavaRandom(0).shuffle(a)
+        b = list(range(10))
+        JavaRandom(0).shuffle(b)
+        assert a == b
+        assert sorted(a) == list(range(10))
+
+    def test_set_seed_resets(self):
+        rd = JavaRandom(0)
+        first = rd.next_int()
+        rd.set_seed(0)
+        assert rd.next_int() == first
+
+
+class TestGPD:
+    def test_matches_reference_constants(self):
+        # ξ=1.4, μ=-0.3, σ=0.35 — the WAN jitter distribution
+        # (NetworkLatency.java:50)
+        gpd = GeneralizedParetoDistribution(1.4, -0.3, 0.35)
+        assert gpd.inverse_f(0.0) == -0.3
+        # closed form: μ + σ/ξ * (-1 + (1-y)^-ξ)
+        y = 0.5
+        expect = -0.3 + 0.35 / 1.4 * (-1 + (1 - y) ** -1.4)
+        assert math.isclose(gpd.inverse_f(y), expect)
+        assert gpd.inverse_f(1.0) == math.inf
+
+    def test_zero_shape_branch(self):
+        gpd = GeneralizedParetoDistribution(0.0, 1.0, 2.0)
+        assert math.isclose(gpd.inverse_f(0.5), 1.0 - 2.0 * math.log1p(-0.5))
+
+    def test_negative_shape_upper(self):
+        gpd = GeneralizedParetoDistribution(-0.5, 0.0, 1.0)
+        assert math.isclose(gpd.inverse_f(1.0), 0.0 - 1.0 / -0.5)
+
+    def test_scale_validation(self):
+        with pytest.raises(ValueError):
+            GeneralizedParetoDistribution(1.0, 0.0, 0.0)
+
+    def test_jnp_matches_scalar(self):
+        from wittgenstein_tpu.utils.gpd import inverse_f_jnp
+
+        gpd = GeneralizedParetoDistribution(1.4, -0.3, 0.35)
+        ys = np.linspace(0.0, 0.99, 50)
+        got = np.asarray(inverse_f_jnp(1.4, -0.3, 0.35, ys))
+        want = np.array([gpd.inverse_f(float(y)) for y in ys])
+        # float32 under jit; the consumer casts to integer milliseconds
+        np.testing.assert_allclose(got, want, rtol=2e-4)
+
+
+class TestMoreMath:
+    def test_log2(self):
+        assert log2(1) == 0
+        assert log2(2) == 1
+        assert log2(3) == 1
+        assert log2(1024) == 10
+
+    def test_round_pow2(self):
+        assert round_pow2(1) == 1
+        assert round_pow2(1000) == 512
+        assert round_pow2(1024) == 1024
+
+
+class TestBitset:
+    def test_include(self):
+        assert include(0b1110, 0b0110)
+        assert not include(0b0110, 0b1110)
+        assert include(0, 0)
+
+    def test_cardinality(self):
+        assert cardinality(0b1011) == 3
+
+    def test_pack_roundtrip(self):
+        bits = (1 << 100) | (1 << 31) | 1
+        words = int_to_packed(bits, 4)
+        assert packed_to_int(words) == bits

@@ -1,0 +1,26 @@
+"""wittgenstein_tpu — a TPU-native framework for large-scale simulation of
+distributed / consensus protocols.
+
+This is a ground-up, TPU-first rebuild of the capabilities of the
+Wittgenstein simulator (reference: /root/reference, pure Java DES).
+Instead of a single-threaded discrete-event loop, the compute path is a
+time-stepped, batched state transition over struct-of-arrays node state,
+`vmap`-ed over simulation replicas and sharded over a `jax.sharding.Mesh`,
+so thousands of independent simulations step in lockstep on TPU.
+
+Layout:
+  core/       engine primitives: batched tick engine, node state, latency
+              models, geo data, registries, parameters
+  oracle/     faithful single-threaded DES, bit-exact with the reference
+              semantics (java.util.Random included) — the parity oracle
+  protocols/  protocol implementations (oracle classes + batched kernels)
+  ops/        packed-bitset and queue kernels (jnp + pallas)
+  parallel/   device mesh / sharding of the replica and node axes
+  runner/     multi-run & progress-per-time drivers, sweeps
+  stats/      StatsHelper-equivalent reductions
+  tools/      plots, CSV, latency-matrix baking, node drawing
+  server/     REST control server (stdlib http)
+  utils/      JavaRandom, Pareto distribution, bitset & math helpers
+"""
+
+__version__ = "0.1.0"

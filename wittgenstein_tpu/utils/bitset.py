@@ -1,0 +1,36 @@
+"""Bitset helpers.
+
+The oracle side uses plain Python ints as bitsets (arbitrary precision, fast
+or/and/popcount).  The batched side uses packed uint32 arrays — see
+wittgenstein_tpu.ops.bitops for the jnp/pallas twins.
+
+Reference semantics: core utils/BitSetUtils.java:8-13 (`include`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def include(big: int, small: int) -> bool:
+    """True iff every bit set in `small` is set in `big`."""
+    return (small & ~big) == 0
+
+
+def cardinality(bits: int) -> int:
+    return bin(bits).count("1")
+
+
+def int_to_packed(bits: int, n_words: int) -> np.ndarray:
+    """Python-int bitset -> packed little-endian uint32 words."""
+    out = np.zeros(n_words, dtype=np.uint32)
+    for w in range(n_words):
+        out[w] = (bits >> (32 * w)) & 0xFFFFFFFF
+    return out
+
+
+def packed_to_int(words: np.ndarray) -> int:
+    bits = 0
+    for w, v in enumerate(np.asarray(words, dtype=np.uint32).tolist()):
+        bits |= int(v) << (32 * w)
+    return bits

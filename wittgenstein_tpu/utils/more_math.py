@@ -1,0 +1,19 @@
+"""Small integer-math helpers (reference: core utils/MoreMath.java:5-18)."""
+
+from __future__ import annotations
+
+
+def log2(x: int) -> int:
+    """Floor of log base 2 of a positive int; log2(0) == 0 like the reference
+    (31 - Integer.numberOfLeadingZeros treats 0 specially there as -1; the
+    reference only calls it on positives)."""
+    if x <= 0:
+        raise ValueError(f"x={x}")
+    return x.bit_length() - 1
+
+
+def round_pow2(x: int) -> int:
+    """Largest power of two <= x (reference rounds down)."""
+    if x <= 0:
+        raise ValueError(f"x={x}")
+    return 1 << (x.bit_length() - 1)
