@@ -118,9 +118,11 @@ class TestMoreMath:
         assert log2(1024) == 10
 
     def test_round_pow2(self):
+        # rounds UP (MoreMath.roundPow2: highestOneBit << 1 when not exact)
         assert round_pow2(1) == 1
-        assert round_pow2(1000) == 512
+        assert round_pow2(1000) == 1024
         assert round_pow2(1024) == 1024
+        assert round_pow2(1025) == 2048
 
 
 class TestBitset:

@@ -1,0 +1,38 @@
+"""TCP throughput-aware delay (Mathis equation).
+
+Reference semantics: core NetworkThroughput.java:17-57.  Closed-form, so the
+vectorized twin is trivial.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..utils.javaops import jint
+from .latency import NetworkLatency
+from .node import Node
+
+
+class NetworkThroughput:
+    def delay(self, from_node: Node, to_node: Node, delta: int, msg_size: int) -> int:
+        raise NotImplementedError
+
+
+class MathisNetworkThroughput(NetworkThroughput):
+    MSS = 1460
+    LOSS = 0.004
+
+    def __init__(self, nl: NetworkLatency, window_size_bytes: int = 87380 * 1024):
+        self.nl = nl
+        self.window_size = 8 * window_size_bytes
+        self._div = math.sqrt(self.LOSS)
+
+    def delay(self, from_node: Node, to_node: Node, delta: int, msg_size: int) -> int:
+        st = self.nl.get_latency(from_node, to_node, delta)
+        if msg_size < self.MSS:
+            return st
+        rtt = st * 2.0
+        bandwidth = (self.MSS * 8) / (rtt * self._div)
+        w_max = self.window_size / rtt
+        av_bandwidth = min(bandwidth, w_max)
+        return jint((8 * msg_size) / av_bandwidth + st)

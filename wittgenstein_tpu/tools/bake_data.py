@@ -1,0 +1,52 @@
+"""Bake the public geographic/latency data into dense npz arrays.
+
+Run once (requires the reference data tree or any same-format data tree):
+
+    python -m wittgenstein_tpu.tools.bake_data [--src DIR]
+
+Produces:
+  wittgenstein_tpu/data/geo_cities.npz    names, merc_x, merc_y, population
+  wittgenstein_tpu/data/city_latency.npz  names, matrix[C,C] float32
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from ..core.geo import parse_cities_csv
+from .latency_csv import build_matrix_from_csvs
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "data")
+
+
+def bake(src: str = "/root/reference/core/src/main/resources", out_dir: str = _DATA_DIR):
+    os.makedirs(out_dir, exist_ok=True)
+
+    cities = parse_cities_csv(os.path.join(src, "cities.csv"))
+    names = list(cities.keys())
+    np.savez_compressed(
+        os.path.join(out_dir, "geo_cities.npz"),
+        names=np.array(names),
+        merc_x=np.array([cities[n][0] for n in names], dtype=np.int32),
+        merc_y=np.array([cities[n][1] for n in names], dtype=np.int32),
+        population=np.array([cities[n][2] for n in names], dtype=np.int64),
+    )
+
+    lat_names, matrix = build_matrix_from_csvs(os.path.join(src, "Data"))
+    np.savez_compressed(
+        os.path.join(out_dir, "city_latency.npz"),
+        names=np.array(lat_names),
+        matrix=matrix,
+    )
+    print(f"baked {len(names)} cities, latency matrix {matrix.shape}")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--src", default="/root/reference/core/src/main/resources")
+    ap.add_argument("--out", default=_DATA_DIR)
+    args = ap.parse_args()
+    bake(args.src, args.out)

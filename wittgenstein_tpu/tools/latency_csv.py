@@ -1,0 +1,119 @@
+"""City-to-city ping matrix loader.
+
+Reference semantics: tools/CSVLatencyReader.java — loads per-city
+wondernetwork ping CSVs (`Data/<City>/<City>Ping.csv`), builds an
+(asymmetric-source, symmetric-fallback) city->city->ms map with
+SAME_CITY_LATENCY=30, and drops cities for which some pair has no
+measurement in either direction.
+
+This module reads the baked dense matrix from wittgenstein_tpu/data when
+present (produced by tools/bake_data.py from the reference's public data
+files), otherwise parses the CSV tree directly.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+SAME_CITY_LATENCY = 30.0
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "data")
+_REFERENCE_DATA = "/root/reference/core/src/main/resources/Data"
+_BAKED = os.path.join(_DATA_DIR, "city_latency.npz")
+
+
+class CSVLatencyReader:
+    """API parity with the reference: .cities() and .get_latency(from, to)."""
+
+    def __init__(self, data_dir: Optional[str] = None):
+        if data_dir is None and os.path.exists(_BAKED):
+            z = np.load(_BAKED, allow_pickle=False)
+            self._names = [str(s) for s in z["names"]]
+            self._matrix = z["matrix"].astype(np.float32)
+        else:
+            if data_dir is None:
+                data_dir = _REFERENCE_DATA
+            names, matrix = build_matrix_from_csvs(data_dir)
+            self._names = names
+            self._matrix = matrix
+        self._index = {n: i for i, n in enumerate(self._names)}
+
+    def cities(self) -> List[str]:
+        return list(self._names)
+
+    def city_index(self) -> Dict[str, int]:
+        return dict(self._index)
+
+    def matrix(self) -> np.ndarray:
+        """Dense [C, C] float32, resolved (from-side value, else to-side),
+        diagonal == SAME_CITY_LATENCY."""
+        return self._matrix
+
+    def get_latency(self, city_from: str, city_to: str) -> float:
+        return float(self._matrix[self._index[city_from], self._index[city_to]])
+
+    def get_latency_matrix(self) -> Dict[str, Dict[str, float]]:
+        return {
+            a: {b: float(self._matrix[i, j]) for j, b in enumerate(self._names)}
+            for i, a in enumerate(self._names)
+        }
+
+
+def _city_from_row(city_and_location: str, all_cities: List[str]) -> Optional[str]:
+    """Longest city name (spaces form) contained in the CSV's 'City Country,
+    Region' column (CSVLatencyReader.processCityName)."""
+    best = None
+    for c in all_cities:
+        if c.replace("+", " ") in city_and_location:
+            if best is None or len(c) > len(best):
+                best = c
+    return best
+
+
+def build_matrix_from_csvs(data_dir: str):
+    """Parse the per-city ping CSV tree into (names, resolved dense matrix)."""
+    cities = sorted(os.listdir(data_dir))
+    raw: Dict[str, Dict[str, float]] = {}
+    for city in cities:
+        path = os.path.join(data_dir, city, city + "Ping.csv")
+        if not os.path.exists(path):
+            continue
+        row_map: Dict[str, float] = {}
+        with open(path, newline="", encoding="utf-8", errors="replace") as f:
+            reader = csv.reader(f)
+            next(reader)  # header
+            for row in reader:
+                if len(row) < 5:
+                    continue
+                target = _city_from_row(row[0], cities)
+                if target is not None:
+                    try:
+                        row_map[target] = float(row[4])
+                    except ValueError:
+                        pass
+        row_map[city] = SAME_CITY_LATENCY
+        raw[city] = row_map
+
+    # Drop cities where some pair has no measurement in either direction
+    names = list(raw.keys())
+    missing = set()
+    for a in names:
+        for b in names:
+            if b not in raw[a] and a not in raw[b]:
+                missing.add(a)
+                break
+    names = [n for n in names if n not in missing]
+
+    c = len(names)
+    matrix = np.zeros((c, c), dtype=np.float32)
+    for i, a in enumerate(names):
+        for j, b in enumerate(names):
+            v = raw[a].get(b)
+            if v is None:
+                v = raw[b][a]
+            matrix[i, j] = v
+    return names, matrix

@@ -23,6 +23,8 @@ def cardinality(bits: int) -> int:
 
 def int_to_packed(bits: int, n_words: int) -> np.ndarray:
     """Python-int bitset -> packed little-endian uint32 words."""
+    if bits >> (32 * n_words):
+        raise ValueError(f"bitset needs more than {n_words} words")
     out = np.zeros(n_words, dtype=np.uint32)
     for w in range(n_words):
         out[w] = (bits >> (32 * w)) & 0xFFFFFFFF

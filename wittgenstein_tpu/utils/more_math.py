@@ -4,16 +4,19 @@ from __future__ import annotations
 
 
 def log2(x: int) -> int:
-    """Floor of log base 2 of a positive int; log2(0) == 0 like the reference
-    (31 - Integer.numberOfLeadingZeros treats 0 specially there as -1; the
-    reference only calls it on positives)."""
+    """Floor of log base 2 of a positive int; raises on x <= 0 like the
+    reference."""
     if x <= 0:
         raise ValueError(f"x={x}")
     return x.bit_length() - 1
 
 
 def round_pow2(x: int) -> int:
-    """Largest power of two <= x (reference rounds down)."""
+    """n rounded UP to the next power of two; n itself if already a power of
+    two (reference MoreMath.roundPow2: highestOneBit, << 1 if not exact)."""
     if x <= 0:
         raise ValueError(f"x={x}")
-    return 1 << (x.bit_length() - 1)
+    res = 1 << (x.bit_length() - 1)
+    if res != x:
+        res <<= 1
+    return res
