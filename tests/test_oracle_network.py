@@ -1,0 +1,408 @@
+"""Oracle-engine conformance suite — semantic port of the reference's
+NetworkTest.java / EnvelopeStorageTest.java: delivery, all send flavors,
+multi-dest (with/without delays, slot boundaries), arrival ordering, stats
+counters, partitions, long runs, task/periodic/conditional semantics
+including stopped nodes."""
+
+import pytest
+
+from wittgenstein_tpu.core.latency import (
+    EthScanNetworkLatency,
+    NetworkLatencyByDistanceWJitter,
+    NetworkNoLatency,
+)
+from wittgenstein_tpu.core.node import Node, NodeBuilder, NodeBuilderWithRandomPosition
+from wittgenstein_tpu.core.geo import MAX_X
+from wittgenstein_tpu.oracle import Message, Network
+from wittgenstein_tpu.oracle.network import (
+    MultipleDestEnvelope,
+    MultipleDestWithDelayEnvelope,
+    get_pseudo_random,
+)
+
+
+class Probe(Message):
+    """Message that records/increments on action."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def action(self, network, from_node, to_node):
+        if self.fn:
+            self.fn(from_node, to_node)
+
+
+@pytest.fixture
+def net():
+    network = Network()
+    nb = NodeBuilder()
+    nodes = [Node(network.rd, nb) for _ in range(4)]
+    network.set_network_latency(NetworkNoLatency())
+    for n in nodes:
+        network.add_node(n)
+    return network, nodes
+
+
+class TestDelivery:
+    def test_simple_message(self, net):
+        network, n = net
+        got = []
+        network.send(Probe(lambda f, t: got.append((f.node_id, t.node_id))), 1, n[1], n[2])
+        assert network.msgs.size() == 1
+        assert got == []
+        network.run(5)
+        assert got == [(1, 2)]
+
+    def test_register_task(self, net):
+        network, n = net
+        fired = []
+        network.register_task(lambda: fired.append(1), 100, n[0])
+        network.run_ms(99)
+        assert not fired
+        network.run_ms(1)
+        assert fired == [1]
+        assert network.msgs.size() == 0
+
+    def test_all_flavors_of_send(self, net):
+        network, n = net
+        a1, a2 = [0], [0]
+
+        def acc(f, t):
+            a1[0] += f.node_id
+            a2[0] += t.node_id
+
+        dests = [n[2], n[3]]
+        network.send(Probe(acc), n[1], n[2])
+        network.send(Probe(acc), 1, n[1], n[2])
+        network.send(Probe(acc), 1, n[1], dests)
+        network.send(Probe(acc), n[1], dests)
+        assert network.msgs.size() == 4
+        network.run(1)
+        assert network.msgs.size() == 0
+        assert a1[0] == 6
+        assert a2[0] == 14
+
+    def test_multiple_message(self, net):
+        network, n = net
+        count = [0]
+        network.send(Probe(lambda f, t: count.__setitem__(0, count[0] + 1)), 1, n[0], [n[1], n[2], n[3]])
+        network.run_ms(2)
+        assert count[0] == 3
+        assert network.msgs.size() == 0
+
+    def test_multiple_message_with_delays(self, net):
+        network, n = net
+        count = [0]
+        network.send(
+            Probe(lambda f, t: count.__setitem__(0, count[0] + 1)),
+            1, n[0], [n[1], n[2], n[3]], 10,
+        )
+        network.run_ms(2)
+        assert count[0] == 1
+        network.run_ms(11)
+        assert count[0] == 2
+        network.run_ms(11)
+        assert count[0] == 3
+        assert network.msgs.size() == 0
+
+    def test_delays_across_slots(self, net):
+        """Reference slot size is 60000 ms; arrivals straddling it must
+        still deliver (NetworkTest.java:147-163)."""
+        network, n = net
+        count = [0]
+        network.send(
+            Probe(lambda f, t: count.__setitem__(0, count[0] + 1)),
+            59000, n[0], [n[1], n[2], n[3]], 55000,
+        )
+        network.run_ms(200000)
+        assert network.msgs.size() == 0
+        assert count[0] == 3
+
+    def test_delays_end_of_slot(self, net):
+        network, n = net
+        count = [0]
+        network.send(
+            Probe(lambda f, t: count.__setitem__(0, count[0] + 1)),
+            58998, n[0], [n[1], n[2], n[3]], 1000,
+        )
+        assert network.msgs.size() == 1
+        network.run_ms(59000)
+        assert network.msgs.size() == 1
+        network.run_ms(3000)
+        assert network.msgs.size() == 0
+        assert count[0] == 3
+
+
+class TestArrivals:
+    def test_msg_arrival_with_delay(self, net):
+        network, n = net
+        m = Probe()
+        mas = network._create_message_arrivals(m, 1, n[0], [n[1], n[2], n[3]], 1, 10)
+        assert [a[1] for a in mas] == [2, 13, 24]
+        e = MultipleDestWithDelayEnvelope(m, n[0], mas, 1)
+        assert e.next_arrival_time(network) == 2
+        e.mark_read()
+        assert e.next_arrival_time(network) == 13
+        e.mark_read()
+        assert e.next_arrival_time(network) == 24
+        assert e.has_next_reader()
+        e.mark_read()
+        assert not e.has_next_reader()
+
+    def _random_net(self):
+        network = Network()
+        nb = NodeBuilderWithRandomPosition()
+        nodes = [Node(network.rd, nb) for _ in range(4)]
+        network.set_network_latency(NetworkLatencyByDistanceWJitter())
+        for nd in nodes:
+            network.add_node(nd)
+        return network, nodes
+
+    def test_msg_arrival_random_no_delay(self):
+        network, n = self._random_net()
+        m = Probe()
+        mas = network._create_message_arrivals(m, 1, n[0], [n[1], n[2], n[3]], 2, 0)
+        assert len(mas) == 3
+        e = MultipleDestEnvelope(m, n[0], mas, 1, 2)
+        assert e.random_seed == 2
+        for dest, arrival in mas:
+            assert e.next_arrival_time(network) == arrival
+            e.mark_read()
+        assert not e.has_next_reader()
+
+    def test_msg_arrival_random_with_delay(self):
+        network, n = self._random_net()
+        m = Probe()
+        mas = network._create_message_arrivals(m, 1, n[0], [n[1], n[2], n[3]], 1, 20)
+        assert len(mas) == 3
+        e = MultipleDestWithDelayEnvelope(m, n[0], mas, 1)
+        for dest, arrival in mas:
+            assert e.next_arrival_time(network) == arrival
+            e.mark_read()
+        assert not e.has_next_reader()
+
+    def test_sorted_arrivals(self, net):
+        network, n = net
+        network.send(Probe(), 1, n[0], [n[1], n[2], n[3]])
+        m = network.msgs.peek_first()
+        assert m is not None
+        dests = {1, 2, 3}
+        last = m.next_arrival_time(network)
+        assert m.next_dest_id() in dests
+        dests.remove(m.next_dest_id())
+        m.mark_read()
+        assert m.has_next_reader()
+        assert m.next_arrival_time(network) >= last
+        dests.remove(m.next_dest_id())
+        m.mark_read()
+        assert m.has_next_reader()
+        assert m.next_dest_id() in dests
+        m.mark_read()
+        assert not m.has_next_reader()
+
+    def test_delays_recomputed_from_seed(self, net):
+        network, n = net
+        network.set_network_latency(EthScanNetworkLatency())
+        m = Probe()
+        network.send(m, 1, n[0], [n[1], n[2], n[3]])
+        e = network.msgs.poll_first()
+        assert isinstance(e, MultipleDestEnvelope)
+        mas = network._create_message_arrivals(
+            m, 1, n[0], [n[1], n[2], n[3]], e.random_seed, 0
+        )
+        for dest, arrival in mas:
+            assert arrival == e.next_arrival_time(network)
+            e.mark_read()
+
+
+class TestStats:
+    def test_counters(self, net):
+        network, n = net
+        m = Probe()
+        network.send(m, n[0], [n[1], n[2], n[3]])
+        network.send(m, n[0], n[1])
+        network.run_ms(2)
+        assert (n[0].msg_received, n[0].bytes_received) == (0, 0)
+        assert (n[0].msg_sent, n[0].bytes_sent) == (4, 4)
+        assert (n[1].msg_received, n[1].bytes_received) == (2, 2)
+        assert (n[2].msg_received, n[2].bytes_received) == (1, 1)
+        assert (n[3].msg_received, n[3].bytes_received) == (1, 1)
+
+
+class TestPartitions:
+    def test_partition(self):
+        network = Network()
+        xs = [0]
+
+        class XB(NodeBuilder):
+            def get_x(self, rd_int):
+                xs[0] += MAX_X // 10
+                return xs[0]
+
+        nb = XB()
+        n = [Node(network.rd, nb) for _ in range(4)]
+        for nd in n:
+            network.add_node(nd)
+        network.set_network_latency(NetworkNoLatency())
+
+        network.partition(0.25)
+        assert int(0.25 * MAX_X) in network.partitions_in_x
+        assert [network.partition_id(x) for x in n] == [0, 0, 1, 1]
+
+        m = Probe()
+        network.send(m, n[0], n[1])
+        assert network.msgs.peek_first() is not None
+        network.msgs.clear()
+        network.send(m, n[1], n[2])
+        assert network.msgs.peek_first() is None
+        network.send(m, n[2], n[3])
+        assert network.msgs.peek_first() is not None
+        network.msgs.clear()
+
+        network.partition(0.35)
+        assert [network.partition_id(x) for x in n] == [0, 0, 1, 2]
+        network.send(m, n[1], n[2])
+        assert network.msgs.peek_first() is None
+        network.send(m, n[2], n[3])
+        assert network.msgs.peek_first() is None
+        network.send(m, n[3], n[0])
+        assert network.msgs.peek_first() is None
+
+        network.end_partition()
+        network.send(m, n[1], n[2])
+        assert network.msgs.peek_first() is not None
+
+    def test_partition_validation(self, net):
+        network, _ = net
+        with pytest.raises(ValueError):
+            network.partition(0.0)
+        with pytest.raises(ValueError):
+            network.partition(1.0)
+        network.partition(0.5)
+        with pytest.raises(ValueError):
+            network.partition(0.5)
+
+
+class TestLongRunning:
+    def test_long_running(self, net):
+        network, n = net
+        m = Probe()
+        while network.time < 10_000_000:
+            network.run_ms(1_000_000)
+            network.send(m, n[0], n[1])
+        assert network.time >= 10_000_000
+
+
+class TestTasks:
+    def test_task_once(self, net):
+        network, n = net
+        count = [0]
+        network.register_task(lambda: count.__setitem__(0, count[0] + 1), 1000, n[0])
+        network.run_ms(500)
+        assert count[0] == 0
+        network.run_ms(500)
+        assert count[0] == 1
+        network.run_ms(5100)
+        assert count[0] == 1
+
+    def test_task_on_stopped_node(self, net):
+        network, n = net
+        count = [0]
+        network.register_task(lambda: count.__setitem__(0, count[0] + 1), 1000, n[0])
+        n[0].stop()
+        network.run_ms(5000)
+        assert count[0] == 0
+
+    def test_periodic_task(self, net):
+        network, n = net
+        count = [0]
+        network.register_periodic_task(
+            lambda: count.__setitem__(0, count[0] + 1), 1000, 100, n[0]
+        )
+        network.run_ms(500)
+        assert count[0] == 0
+        network.run_ms(500)
+        assert count[0] == 1
+        network.run_ms(100)
+        assert count[0] == 2
+        network.run_ms(50)
+        assert count[0] == 2
+        n[0].stop()
+        network.run_ms(1000)
+        assert count[0] == 2
+
+    def test_conditional_task(self, net):
+        network, n = net
+        gate = [False]
+        count = [0]
+        network.register_conditional_task(
+            lambda: count.__setitem__(0, count[0] + 1),
+            1000, 100, n[0], lambda: gate[0], lambda: True,
+        )
+        network.run_ms(500)
+        assert count[0] == 0
+        network.run_ms(500)
+        assert count[0] == 0
+        gate[0] = True
+        network.run_ms(1)
+        assert count[0] == 1
+        network.run_ms(99)
+        assert count[0] == 1
+        network.run_ms(1)
+        assert count[0] == 2
+        n[0].stop()
+        network.run_ms(1000)
+        assert count[0] == 2
+
+
+class TestStorage:
+    """EnvelopeStorageTest semantics: LIFO within a millisecond."""
+
+    def test_lifo_within_ms(self, net):
+        network, n = net
+        order = []
+        for tag in ("a", "b", "c"):
+            network.send(
+                Probe(lambda f, t, tag=tag: order.append(tag)), 5, n[0], n[1]
+            )
+        network.run_ms(10)
+        assert order == ["c", "b", "a"]  # head-insertion, poll from head
+
+    def test_cannot_add_in_past(self, net):
+        network, n = net
+        network.run_ms(100)
+        with pytest.raises(ValueError):
+            network.send_arrive_at(Probe(), 50, n[0], n[1])
+
+    def test_peek_messages_sorted(self, net):
+        network, n = net
+        network.send(Probe(), 50, n[0], n[1])
+        network.send(Probe(), 5, n[0], n[1])
+        infos = network.msgs.peek_messages()
+        assert [i.arriving_at for i in infos] == sorted(i.arriving_at for i in infos)
+
+
+class TestPseudoRandom:
+    def test_range_and_determinism(self):
+        vals = [get_pseudo_random(i, 12345) for i in range(1000)]
+        assert all(0 <= v <= 99 for v in vals)
+        assert vals == [get_pseudo_random(i, 12345) for i in range(1000)]
+        # roughly uniform
+        import collections
+
+        c = collections.Counter(vals)
+        assert len(c) == 100
+
+    def test_min_value_edge(self):
+        # Math.abs(Integer.MIN_VALUE) path must not crash
+        v = get_pseudo_random(-(2**31), -(2**31))
+        assert 0 <= v <= 99
+
+
+class TestBadNodes:
+    def test_choose_bad_nodes_keeps_node1(self):
+        from wittgenstein_tpu.utils.javarand import JavaRandom
+
+        bad = Network.choose_bad_nodes(JavaRandom(0), 100, 50)
+        assert len(bad) == 50
+        assert 1 not in bad

@@ -1,0 +1,175 @@
+"""P2P overlay + flood conformance (reference P2PNetworkTest.java) and
+blockchain layer basics."""
+
+import pytest
+
+from wittgenstein_tpu.core.latency import NetworkNoLatency
+from wittgenstein_tpu.core.node import NodeBuilder
+from wittgenstein_tpu.oracle import (
+    Block,
+    BlockChainNetwork,
+    BlockChainNode,
+    FloodMessage,
+    P2PNetwork,
+    P2PNode,
+    StatusFloodMessage,
+)
+from wittgenstein_tpu.utils.more_math import log2
+
+
+MIN_PEERS = 5
+
+
+@pytest.fixture
+def p2p():
+    network = P2PNetwork(MIN_PEERS, True)
+    nb = NodeBuilder()
+    network.set_network_latency(NetworkNoLatency())
+    nodes = [P2PNode(network.rd, nb) for _ in range(104)]
+    for n in nodes:
+        network.add_node(n)
+    network.set_peers()
+    return network, nodes
+
+
+def count_received(network, m):
+    node_ct = 0
+    for n in network.all_nodes:
+        size = len(n.get_msg_received(m.msg_id()))
+        assert size in (0, 1)
+        node_ct += size
+    return node_ct
+
+
+class TestP2P:
+    def test_minimum_peers(self, p2p):
+        network, _ = p2p
+        for n in network.all_nodes:
+            assert len(n.peers) >= MIN_PEERS
+
+    def test_avg_peers_mode(self):
+        network = P2PNetwork(10, False)
+        nb = NodeBuilder()
+        network.set_network_latency(NetworkNoLatency())
+        for _ in range(100):
+            network.add_node(P2PNode(network.rd, nb))
+        network.set_peers()
+        assert network.avg_peers() >= 9  # avg mode targets size*cc/2 links
+        for n in network.all_nodes:
+            assert len(n.peers) >= 3
+
+    def test_flood_no_delay(self, p2p):
+        network, nodes = p2p
+        n0 = nodes[0]
+        m = FloodMessage(1, 0, 0)
+        network.send_peers(m, n0)
+        assert len(n0.get_msg_received(m.msg_id())) == 1
+
+        network.run_ms(2)
+        node_ct = 0
+        for n in network.all_nodes:
+            if n is n0 or n in n0.peers:
+                assert len(n.get_msg_received(m.msg_id())) == 1
+                node_ct += 1
+            else:
+                assert len(n.get_msg_received(m.msg_id())) == 0
+
+        for _ in range(log2(len(network.all_nodes)) + 1):
+            if node_ct >= len(network.all_nodes):
+                break
+            network.run_ms(2)
+            node_ct2 = count_received(network, m)
+            assert node_ct2 > node_ct
+            node_ct = node_ct2
+        assert node_ct == len(network.all_nodes)
+
+    def test_flood_with_delay(self, p2p):
+        network, nodes = p2p
+        n0 = nodes[0]
+        m = FloodMessage(1, 10, 15)
+        network.send_peers(m, n0)
+        assert count_received(network, m) == 1
+        network.run_ms(11)
+        assert count_received(network, m) == 1
+        network.run_ms(1)
+        assert count_received(network, m) == 2
+        assert network.time == 12
+        network.run_ms(11)
+        assert count_received(network, m) == 2
+        network.run_ms(1)
+        assert count_received(network, m) == 3
+
+    def test_status_flood_keeps_latest(self, p2p):
+        network, nodes = p2p
+        n1 = nodes[1]
+        old = StatusFloodMessage(7, 1, 1, 0, 0)
+        new = StatusFloodMessage(7, 2, 1, 0, 0)
+        assert old.add_to_received(n1)
+        assert new.add_to_received(n1)  # higher seq replaces
+        assert not old.add_to_received(n1)  # lower seq rejected
+        assert next(iter(n1.get_msg_received(7))).seq == 2
+
+    def test_disconnect(self, p2p):
+        network, nodes = p2p
+        n0 = nodes[0]
+        peers = list(n0.peers)
+        network.disconnect(n0)
+        assert n0.peers == []
+        for p in peers:
+            assert n0 not in p.peers
+
+
+class _TestChainNode(BlockChainNode):
+    def best(self, cur, alt):
+        return alt if alt.height > cur.height else cur
+
+
+class TestBlockchain:
+    def test_block_tree(self):
+        Block.reset_block_ids()
+        genesis = Block(genesis=True)
+        net = BlockChainNetwork()
+        net.set_network_latency(NetworkNoLatency())
+        nb = NodeBuilder()
+        n = _TestChainNode(net.rd, nb, False, genesis)
+        net.add_observer(n)
+
+        b1 = Block(n, 1, genesis, True, 10)
+        b2 = Block(n, 2, b1, True, 20)
+        fork = Block(n, 2, b1, True, 25)
+        assert genesis.is_ancestor(b2)
+        assert b1.is_ancestor(b2)
+        assert not b2.is_ancestor(b1)
+        assert b2.has_direct_link(b1)
+        assert not b2.has_direct_link(fork)
+        assert b2.tx_count() == 10  # lastTxId delta
+
+        assert n.on_block(b1)
+        assert n.on_block(b2)
+        assert not n.on_block(b2)  # duplicate
+        assert n.head is b2
+        assert n.on_block(fork)
+        assert n.head is b2  # same height, keeps current
+
+    def test_invalid_block_rejected(self):
+        Block.reset_block_ids()
+        genesis = Block(genesis=True)
+        net = BlockChainNetwork()
+        nb = NodeBuilder()
+        n = _TestChainNode(net.rd, nb, False, genesis)
+        bad = Block(n, 1, genesis, False, 5)
+        assert not n.on_block(bad)
+        assert n.head is genesis
+
+    def test_block_validation(self):
+        Block.reset_block_ids()
+        genesis = Block(genesis=True)
+        net = BlockChainNetwork()
+        n = _TestChainNode(net.rd, NodeBuilder(), False, genesis)
+        with pytest.raises(ValueError):
+            Block(n, 0, genesis, True, 0)  # non-genesis height 0
+        b1 = Block(n, 5, genesis, True, 10)
+        with pytest.raises(ValueError):
+            Block(n, 5, b1, True, 20)  # parent height >= mine
+        with pytest.raises(ValueError):
+            Block(n, 6, b1, True, 5)  # time before parent

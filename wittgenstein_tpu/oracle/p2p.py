@@ -1,0 +1,111 @@
+"""P2P overlay: random graph with average-degree or minimum-degree modes.
+
+Reference semantics: core P2PNetwork.java / P2PNode.java, including the
+exact RNG consumption order of setPeers (link creation loop, then a
+shuffled per-node top-up pass).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, TypeVar
+
+from ..core.node import Node, NodeBuilder
+from ..utils.javarand import JavaRandom
+from .messages import FloodMessage
+from .network import Network
+
+TP = TypeVar("TP", bound="P2PNode")
+
+
+class P2PNode(Node):
+    __slots__ = ("peers", "_received")
+
+    def __init__(self, rd: JavaRandom, nb: NodeBuilder, byzantine: bool = False):
+        super().__init__(rd, nb, byzantine)
+        self.peers: List["P2PNode"] = []
+        self._received: Dict[int, Set[FloodMessage]] = {}
+
+    def get_msg_received(self, msg_id: int) -> Set[FloodMessage]:
+        return self._received.setdefault(msg_id, set())
+
+    def on_flood(self, from_node: "P2PNode", flood_message: FloodMessage) -> None:
+        pass
+
+
+class P2PNetwork(Network[TP]):
+    def __init__(self, connection_count: int, minimum: bool):
+        super().__init__()
+        self._connection_count = connection_count
+        self._minimum = minimum
+        self._existing_links: Set[tuple] = set()
+
+    def set_peers(self) -> None:
+        size = len(self.all_nodes)
+        if self._connection_count >= size:
+            raise ValueError(
+                f"Wrong configuration: #nodes={size}, connection target={self._connection_count}"
+            )
+
+        if not self._minimum:
+            to_create = (size * self._connection_count) // 2
+            while to_create != len(self._existing_links):
+                pp1 = self.rd.next_int(size)
+                pp2 = self.rd.next_int(size)
+                self._create_link(pp1, pp2)
+
+        # Shuffled top-up pass so dead-node clustering doesn't bias degrees
+        # (P2PNetwork.java:44-56)
+        an = list(self.all_nodes)
+        self.rd.shuffle(an)
+        target_min = self._connection_count if self._minimum else min(3, self._connection_count)
+        for n in an:
+            while len(n.peers) < target_min:
+                pp2 = self.rd.next_int(size)
+                self._create_link(n.node_id, pp2)
+
+    def create_link(self, p1: TP, p2: TP) -> None:
+        self._create_link(p1.node_id, p2.node_id)
+
+    def remove_link(self, p1: TP, p2: TP) -> None:
+        self._remove_link(p1.node_id, p2.node_id)
+
+    def disconnect(self, p: TP) -> None:
+        for n in list(p.peers):
+            self.remove_link(p, n)
+
+    def _create_link(self, pp1: int, pp2: int) -> None:
+        if pp1 == pp2:
+            return
+        link = (min(pp1, pp2), max(pp1, pp2))
+        if link in self._existing_links:
+            return
+        self._existing_links.add(link)
+        p1, p2 = self.all_nodes[pp1], self.all_nodes[pp2]
+        if p1 is None or p2 is None:
+            raise RuntimeError(f"should not be null: pp1={pp1}, pp2={pp2}")
+        p1.peers.append(p2)
+        p2.peers.append(p1)
+
+    def _remove_link(self, pp1: int, pp2: int) -> None:
+        if pp1 == pp2:
+            return
+        link = (min(pp1, pp2), max(pp1, pp2))
+        if link not in self._existing_links:
+            raise RuntimeError(f"link between {pp1} and {pp2} does not exist")
+        self._existing_links.remove(link)
+        p1, p2 = self.all_nodes[pp1], self.all_nodes[pp2]
+        p1.peers.remove(p2)
+        p2.peers.remove(p1)
+
+    def avg_peers(self) -> int:
+        if not self.all_nodes:
+            return 0
+        return sum(len(n.peers) for n in self.all_nodes) // len(self.all_nodes)
+
+    def send_peers(self, msg: FloodMessage, from_node: TP) -> None:
+        msg.add_to_received(from_node)
+        dest = list(from_node.peers)
+        self.rd.shuffle(dest)
+        self.send(
+            msg, self.time + 1 + msg.local_delay, from_node, dest, msg.delay_between_peers
+        )

@@ -1,0 +1,12 @@
+"""Protocol implementations.
+
+Each protocol has an oracle implementation (exact DES semantics, classes on
+wittgenstein_tpu.oracle) and — for the performance-critical families — a
+batched TPU implementation (kernels on wittgenstein_tpu.core.engine).
+Importing this package registers every protocol in
+wittgenstein_tpu.core.params.protocol_registry (the API-discovery contract).
+"""
+
+from . import pingpong  # noqa: F401
+
+__all__ = ["pingpong"]

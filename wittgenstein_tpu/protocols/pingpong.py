@@ -1,0 +1,83 @@
+"""PingPong: the sample protocol — a witness Pings everyone, nodes Pong back.
+
+Reference semantics: protocols/PingPong.java.  Canonical first target for
+both engines: the oracle run is the golden sequence, the batched engine must
+match it distributionally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..core.node import Node
+from ..core.params import WParameters, register_protocol
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..oracle.messages import Message
+from ..oracle.network import Network, Protocol
+
+
+@dataclasses.dataclass
+class PingPongParameters(WParameters):
+    node_ct: int = 1000
+    node_builder_name: Optional[str] = None
+    network_latency_name: Optional[str] = None
+
+
+class Ping(Message):
+    def action(self, network, from_node, to_node):
+        to_node.on_ping(from_node)
+
+
+class Pong(Message):
+    def action(self, network, from_node, to_node):
+        to_node.on_pong()
+
+
+class PingPongNode(Node):
+    __slots__ = ("pong", "_net")
+
+    def __init__(self, network, nb):
+        super().__init__(network.rd, nb)
+        self.pong = 0
+        self._net = network
+
+    def on_ping(self, from_node):
+        self._net.send(Pong(), self, from_node)
+
+    def on_pong(self):
+        self.pong += 1
+
+
+@register_protocol("PingPong", PingPongParameters)
+class PingPong(Protocol):
+    def __init__(self, params: PingPongParameters):
+        self.params = params
+        self.nb = registry_node_builders.get_by_name(params.node_builder_name)
+        self._network: Network[PingPongNode] = Network()
+        self._network.set_network_latency(
+            registry_network_latencies.get_by_name(params.network_latency_name)
+        )
+
+    def copy(self) -> "PingPong":
+        return PingPong(self.params)
+
+    def init(self) -> None:
+        for _ in range(self.params.node_ct):
+            self._network.add_node(PingPongNode(self._network, self.nb))
+        self._network.send_all(Ping(), self._network.get_node_by_id(0))
+
+    def network(self) -> Network:
+        return self._network
+
+
+def main():
+    p = PingPong(PingPongParameters())
+    p.init()
+    for i in range(0, 500, 50):
+        print(f"{i} ms, pongs received {p.network().get_node_by_id(0).pong}")
+        p.network().run_ms(50)
+
+
+if __name__ == "__main__":
+    main()
