@@ -1,0 +1,94 @@
+"""Benchmark: batched-engine simulation throughput vs the oracle DES.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Current flagship config: PingPong 1000 nodes, NetworkLatencyByDistanceWJitter,
+700 simulated ms (full convergence — BASELINE.md README progression).  The
+baseline is the single-threaded oracle DES running the identical simulation
+on the host, which is this rebuild's stand-in for the reference Java loop
+(same algorithm, same event semantics).  vs_baseline = batched sims/sec
+divided by oracle sims/sec, i.e. the TPU speedup factor."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _ensure_backend() -> None:
+    """If the pinned JAX_PLATFORMS value can't initialize (e.g. the TPU
+    tunnel is down), re-exec with auto-selection so the bench still runs."""
+    try:
+        import jax
+
+        jax.devices()
+    except RuntimeError:
+        if not os.environ.get("JAX_PLATFORMS"):
+            raise  # auto-selection already failed; re-exec would loop
+        env = dict(os.environ, JAX_PLATFORMS="")
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+SIM_MS = 700
+NODE_CT = 1000
+
+
+def bench_oracle(runs: int = 3) -> float:
+    from wittgenstein_tpu.protocols.pingpong import PingPong, PingPongParameters
+
+    # time only run_ms, like the batched side (construction/init amortize)
+    elapsed = 0.0
+    for seed in range(runs):
+        p = PingPong(PingPongParameters(node_ct=NODE_CT))
+        p.network().rd.set_seed(seed)
+        p.init()
+        t0 = time.perf_counter()
+        p.network().run_ms(SIM_MS)
+        elapsed += time.perf_counter() - t0
+        assert p.network().get_node_by_id(0).pong == NODE_CT
+    return runs / elapsed
+
+
+def bench_batched() -> float:
+    import jax
+
+    from wittgenstein_tpu.engine import replicate_state
+    from wittgenstein_tpu.protocols.pingpong_batched import make_pingpong
+
+    platform = jax.devices()[0].platform
+    n_replicas = 256 if platform == "tpu" else 16
+
+    net, state = make_pingpong(NODE_CT)
+    states = replicate_state(state, n_replicas)
+    run = jax.jit(lambda s: net.run_ms_batched(s, SIM_MS))
+    out = run(states)  # compile + warmup
+    jax.block_until_ready(out)
+    assert int(out.proto["pong"][:, 0].min()) == NODE_CT, "sim did not converge"
+    assert int(out.dropped.max()) == 0, "message ring overflow"
+
+    t0 = time.perf_counter()
+    out = run(states)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return n_replicas / dt
+
+
+def main() -> None:
+    _ensure_backend()
+    batched = bench_batched()
+    oracle = bench_oracle()
+    print(
+        json.dumps(
+            {
+                "metric": f"pingpong{NODE_CT}_sims_per_sec_chip",
+                "value": round(batched, 3),
+                "unit": "sims/sec",
+                "vs_baseline": round(batched / oracle, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

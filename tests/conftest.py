@@ -1,6 +1,10 @@
 """Test configuration: force the CPU backend with 8 virtual devices so the
-sharding/multi-chip paths are exercised without TPU hardware.  Must run
-before any jax import (pytest imports conftest first)."""
+sharding/multi-chip paths are exercised without TPU hardware.
+
+Note: the environment's sitecustomize registers the remote-TPU 'axon'
+platform and forces jax_platforms=axon at the *config* level, which both
+overrides the JAX_PLATFORMS env var and hangs every jax call when the
+tunnel is down — so we must override the config too, after importing jax."""
 
 import os
 
@@ -10,3 +14,7 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,119 @@
+"""Batched engine v1: exact-parity (fixed latency) and distributional-parity
+(WAN jitter) tests against the oracle DES, plus replica batching and
+determinism.  Strategy per SURVEY §4/§7: oracle is the golden source; the
+batched engine must match exactly where randomness is absent and
+distributionally (±tolerance) where it is counter-based."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wittgenstein_tpu.engine import replicate_state
+from wittgenstein_tpu.engine.core import stack_states
+from wittgenstein_tpu.protocols.pingpong import PingPong, PingPongParameters
+from wittgenstein_tpu.protocols.pingpong_batched import make_pingpong
+
+
+def oracle_progression(node_ct, latency_name, points, step):
+    p = PingPong(
+        PingPongParameters(node_ct=node_ct, network_latency_name=latency_name)
+    )
+    p.init()
+    out = []
+    for _ in range(points):
+        p.network().run_ms(step)
+        out.append(p.network().get_node_by_id(0).pong)
+    return out
+
+
+def batched_progression(net, state, points, step):
+    # batched run_ms(ms) processes ticks [time, time+ms) while the oracle
+    # includes the boundary tick; pre-running 1 tick aligns the checkpoints
+    state = net.run_ms(state, 1)
+    out = []
+    for _ in range(points):
+        state = net.run_ms(state, step)
+        out.append(int(state.proto["pong"][0]))
+    return out, state
+
+
+class TestExactParity:
+    def test_fixed_latency_exact(self):
+        """No randomness in the latency -> message counts must match the
+        oracle exactly (modulo the documented 1-tick boundary shift)."""
+        n = 50
+        oracle = oracle_progression(n, "NetworkFixedLatency(100)", 3, 101)
+        net, state = make_pingpong(n, network_latency_name="NetworkFixedLatency(100)")
+        got, state = batched_progression(net, state, 3, 101)
+        # ping t=1 -> arrives 101; pong sent 102 -> arrives 202; witness
+        # self-round-trip (latency 1) completes at t=4
+        assert oracle == [1, 50, 50]
+        assert got == [1, 50, 50]
+        assert int(state.dropped) == 0
+
+    def test_counters_exact(self):
+        n = 20
+        net, state = make_pingpong(n, network_latency_name="NetworkFixedLatency(100)")
+        state = net.run_ms(state, 50)
+        p = PingPong(
+            PingPongParameters(
+                node_ct=n, network_latency_name="NetworkFixedLatency(100)"
+            )
+        )
+        p.init()
+        p.network().run_ms(50)
+        o_sent = [nd.msg_sent for nd in p.network().all_nodes]
+        o_recv = [nd.msg_received for nd in p.network().all_nodes]
+        assert list(np.asarray(state.msg_sent)) == o_sent
+        assert list(np.asarray(state.msg_received)) == o_recv
+        assert list(np.asarray(state.bytes_sent)) == o_sent  # size=1 msgs
+
+
+class TestDistributionalParity:
+    def test_wan_jitter_progression(self):
+        """Default config (1000 nodes, NetworkLatencyByDistanceWJitter):
+        batched progression must track the oracle CDF closely — same
+        positions, counter-based vs sequential jitter draws."""
+        oracle = oracle_progression(1000, None, 8, 100)
+        net, state = make_pingpong(1000)
+        got, state = batched_progression(net, state, 8, 100)
+        assert int(state.dropped) == 0
+        assert got[-1] == 1000  # full convergence
+        for o, g in zip(oracle, got):
+            assert abs(o - g) <= max(40, 0.08 * max(o, 1)), (oracle, got)
+
+    def test_replica_spread(self):
+        """Replicas with different seeds produce different-but-close CDFs."""
+        net, state = make_pingpong(300)
+        states = replicate_state(state, 4, seeds=[1, 2, 3, 4])
+        # sample mid-convergence, where the CDF is steep and seeds visible
+        states = net.run_ms_batched(states, 220)
+        pongs = np.asarray(states.proto["pong"][:, 0])
+        assert (pongs > 20).all() and (pongs < 300).any()
+        assert len(set(pongs.tolist())) > 1  # seeds actually differ
+
+
+class TestBatching:
+    def test_determinism(self):
+        net, s1 = make_pingpong(100, seed=7)
+        _, s2 = make_pingpong(100, seed=7)
+        r1 = net.run_ms(s1, 300)
+        r2 = net.run_ms(s2, 300)
+        assert jax.tree_util.tree_all(
+            jax.tree_util.tree_map(
+                lambda a, b: bool(jnp.array_equal(a, b)), r1, r2
+            )
+        )
+
+    def test_stack_states(self):
+        net, s1 = make_pingpong(60, seed=1)
+        _, s2 = make_pingpong(60, seed=2)
+        states = stack_states([s1, s2])
+        out = net.run_ms_batched(states, 500)
+        assert int(out.proto["pong"][0][0]) == 60
+        assert int(out.proto["pong"][1][0]) == 60
+
+    def test_all_done(self):
+        net, state = make_pingpong(80)
+        state = net.run_ms(state, 900)
+        assert bool(net.protocol.all_done(state))

@@ -1,0 +1,125 @@
+"""P2PFlood: oracle conformance (ported from P2PFloodTest.java) and
+batched-engine parity."""
+
+import numpy as np
+
+from wittgenstein_tpu.core.registries import builder_name
+from wittgenstein_tpu.protocols.p2pflood import P2PFlood, P2PFloodParameters
+from wittgenstein_tpu.protocols.p2pflood_batched import make_p2pflood
+
+NB_RANDOM = builder_name("RANDOM", True, 0)
+
+
+def params_no_latency(**kw):
+    base = dict(
+        node_count=100,
+        dead_node_count=10,
+        delay_before_resent=50,
+        msg_count=1,
+        msg_to_receive=1,
+        peers_count=10,
+        delay_between_sends=30,
+        node_builder_name=NB_RANDOM,
+        network_latency_name="NetworkNoLatency",
+    )
+    base.update(kw)
+    return P2PFloodParameters(**base)
+
+
+class TestOracleP2PFlood:
+    def test_simple_run(self):
+        """P2PFloodTest.testSimpleRun: live nodes all flooded, dead untouched."""
+        po = P2PFlood(params_no_latency())
+        p = po.copy()
+        p.init()
+        p.network().run(20)
+        po.init()
+        assert len(p.network().all_nodes) == 100
+        for n in p.network().all_nodes:
+            expected = 0 if n.is_down() else 1
+            assert len(n.get_msg_received(-1)) == expected
+
+    def test_copy(self):
+        """P2PFloodTest.testCopy (scaled): same-seed runs are identical."""
+        p1 = P2PFlood(
+            params_no_latency(
+                node_count=500,
+                network_latency_name="NetworkLatencyByDistanceWJitter",
+            )
+        )
+        p2 = p1.copy()
+        p1.init()
+        p1.network().run_ms(1000)
+        p2.init()
+        p2.network().run_ms(1000)
+        for n1 in p1.network().all_nodes:
+            n2 = p2.network().get_node_by_id(n1.node_id)
+            assert n1.done_at == n2.done_at
+            assert n1.is_down() == n2.is_down()
+            assert len(n1.get_msg_received(-1)) == len(n2.get_msg_received(-1))
+            assert n1.x == n2.x and n1.y == n2.y
+            assert [p.node_id for p in n1.peers] == [p.node_id for p in n2.peers]
+
+
+class TestBatchedP2PFlood:
+    def test_exact_parity_no_latency(self):
+        """delay_between_sends=0 + NetworkNoLatency removes all randomness:
+        reach, totals, and done_at must match the oracle exactly."""
+        params = params_no_latency(delay_between_sends=0)
+        oracle = P2PFlood(params)
+        oracle.init()
+        oracle.network().run(20)
+
+        # all flood activity ends within ~1 sim-second (hops of ~52 ms);
+        # the shorter batched run keeps the CPU scan quick
+        net, state = make_p2pflood(params, capacity=2048)
+        state = net.run_ms(state, 2_001)
+        assert int(state.dropped) == 0
+
+        received = np.asarray(state.proto["received"][:, 0])
+        down = np.asarray(state.down)
+        for n in oracle.network().all_nodes:
+            assert bool(down[n.node_id]) == n.is_down()
+            assert bool(received[n.node_id]) == (len(n.get_msg_received(-1)) > 0)
+
+        o_sent = sum(n.msg_sent for n in oracle.network().all_nodes)
+        o_recv = sum(n.msg_received for n in oracle.network().all_nodes)
+        assert int(np.asarray(state.msg_sent).sum()) == o_sent
+        assert int(np.asarray(state.msg_received).sum()) == o_recv
+
+        o_done = np.array([n.done_at for n in oracle.network().all_nodes])
+        b_done = np.asarray(state.done_at)
+        assert (o_done == b_done).all()
+
+    def test_multi_flood(self):
+        """msg_count=3 senders; every live node collects all three."""
+        params = params_no_latency(msg_count=3, msg_to_receive=3, delay_between_sends=0)
+        net, state = make_p2pflood(params, capacity=4096)
+        state = net.run_ms(state, 2_000)
+        received = np.asarray(state.proto["received"])
+        down = np.asarray(state.down)
+        assert received[~down].all()
+        assert not received[down].any()
+        assert (np.asarray(state.done_at)[~down] > 0).all()
+        assert bool(net.protocol.all_done(state))
+
+    def test_jittered_distributional(self):
+        """WAN jitter: batched done_at distribution tracks the oracle."""
+        params = params_no_latency(
+            node_count=128,
+            dead_node_count=0,
+            delay_between_sends=0,
+            network_latency_name="NetworkLatencyByDistanceWJitter",
+        )
+        oracle = P2PFlood(params)
+        oracle.init()
+        oracle.network().run_ms(5000)
+        o_done = np.array(
+            [n.done_at for n in oracle.network().all_nodes if not n.is_down()]
+        )
+
+        net, state = make_p2pflood(params, capacity=4096)
+        state = net.run_ms(state, 2001)
+        b_done = np.asarray(state.done_at)[~np.asarray(state.down)]
+        assert (b_done > 0).all()
+        assert abs(float(b_done.mean()) - float(o_done.mean())) < 0.15 * o_done.mean()

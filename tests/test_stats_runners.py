@@ -1,0 +1,127 @@
+"""Stats framework, scenario runners, Graph and CSVFormatter
+(reference test patterns: StatsTest, GraphTest, CSVFormatterTest, plus
+RunMultipleTimes/ProgressPerTime driving P2PFlood like P2PFlood.time)."""
+
+import os
+
+from wittgenstein_tpu.core import stats as SH
+from wittgenstein_tpu.core.runners import ProgressPerTime, RunMultipleTimes
+from wittgenstein_tpu.protocols.p2pflood import P2PFlood, P2PFloodParameters
+from wittgenstein_tpu.tools.csv_formatter import CSVFormatter
+from wittgenstein_tpu.tools.graph import Graph, ReportLine, Series, stat_series
+
+
+class FakeNode:
+    def __init__(self, done_at=0, msg_received=0):
+        self.done_at = done_at
+        self.msg_received = msg_received
+
+
+class TestStats:
+    def test_simple_stats(self):
+        nodes = [FakeNode(done_at=d) for d in (10, 20, 31)]
+        s = SH.get_done_at(nodes)
+        assert (s.min, s.max, s.avg) == (10, 31, 20)  # Java long division
+
+    def test_avg_across_runs(self):
+        s1 = SH.SimpleStats(0, 10, 5)
+        s2 = SH.SimpleStats(2, 21, 8)
+        a = SH.avg([s1, s2])
+        assert (a.get("min"), a.get("max"), a.get("avg")) == (1, 15, 6)
+
+    def test_avg_single(self):
+        s1 = SH.SimpleStats(1, 2, 3)
+        assert SH.avg([s1]) is s1
+
+    def test_counter(self):
+        c = SH.avg([SH.Counter(4), SH.Counter(7)])
+        assert c.get("count") == 5
+
+
+def flood_params(**kw):
+    from wittgenstein_tpu.core.registries import builder_name
+
+    base = dict(
+        node_count=64,
+        dead_node_count=0,
+        delay_before_resent=1,
+        msg_count=1,
+        msg_to_receive=1,
+        peers_count=8,
+        delay_between_sends=0,
+        node_builder_name=builder_name("RANDOM", True, 0),
+        network_latency_name="NetworkNoLatency",
+    )
+    base.update(kw)
+    return P2PFloodParameters(**base)
+
+
+class TestRunners:
+    def test_run_multiple_times(self):
+        """P2PFlood.run pattern: multi-seed runs, averaged stats."""
+        rmt = RunMultipleTimes(
+            P2PFlood(flood_params()),
+            run_count=3,
+            max_time=0,
+            stats_getters=[SH.DoneAtStatGetter(), SH.MsgReceivedStatGetter()],
+        )
+        res = rmt.run(RunMultipleTimes.cont_until_done())
+        done, msg = res
+        assert done.get("max") > 0
+        assert msg.get("avg") > 0
+
+    def test_progress_per_time(self, tmp_path):
+        ppt = ProgressPerTime(
+            P2PFlood(flood_params()),
+            "",
+            "node count",
+            SH.CounterStatsGetter(lambda n: n.done_at > 0),
+            2,
+            None,
+            10,
+            verbose=False,
+        )
+        graph_path = str(tmp_path / "graph.png")
+
+        def cont(p):
+            if p.network().time > 50000:
+                return False
+            return any(n.done_at == 0 for n in p.network().live_nodes())
+
+        raw = ppt.run(cont, graph_path=graph_path)
+        assert os.path.exists(graph_path)
+        assert len(raw["count"]) == 2
+        final = raw["count"][0].vals[-1].y
+        assert final == 64  # all live nodes done
+
+
+class TestGraphTools:
+    def test_stat_series(self):
+        s1, s2 = Series("a"), Series("b")
+        for x, (y1, y2) in enumerate([(1, 3), (2, 4), (5, 5)]):
+            s1.add_line(ReportLine(x, y1))
+            s2.add_line(ReportLine(x, y2))
+        ss = stat_series("t", [s1, s2])
+        assert [v.y for v in ss.min.vals] == [1, 2, 5]
+        assert [v.y for v in ss.max.vals] == [3, 4, 5]
+        assert [v.y for v in ss.avg.vals] == [2, 3, 5]
+
+    def test_clean_series(self):
+        g = Graph("t", "x", "y")
+        s = Series("s")
+        for x, y in [(0, 0), (1, 5), (2, 9), (3, 9), (4, 9)]:
+            s.add_line(ReportLine(x, y))
+        g.add_serie(s)
+        g.clean_series()
+        assert len(s.vals) == 3  # flat tail trimmed
+
+    def test_csv_formatter(self):
+        f = CSVFormatter("results", ["a", "b", "c"])
+        f.add({"a": 1, "c": 3})
+        f.add({"a": 4, "b": 5, "c": 6})
+        txt = f.to_string()
+        lines = txt.strip().split("\n")
+        assert lines[0] == "results"
+        assert lines[1] == "a,b,c"
+        assert lines[2] == "1,,3"
+        assert lines[3] == "4,5,6"

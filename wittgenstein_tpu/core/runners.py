@@ -1,0 +1,150 @@
+"""Scenario runners.
+
+Reference semantics: core RunMultipleTimes.java (N reseeded runs, stats
+averaged across runs) and ProgressPerTime.java (per-interval stat series,
+traffic summary, graph.png).  On the batched engine these are superseded by
+vmap sweeps (engine.sweep), but the host-side runners stay as the oracle
+scenario drivers and the conformance baseline.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from . import stats as SH
+
+
+class RunMultipleTimes:
+    """N runs of protocol.copy() with rd.setSeed(i); returns per-getter
+    averages (RunMultipleTimes.java:14-88)."""
+
+    def __init__(
+        self,
+        p,
+        run_count: int,
+        max_time: int,
+        stats_getters: List[SH.StatsGetter],
+        final_check: Optional[Callable] = None,
+    ):
+        self.p = p
+        self.run_count = run_count
+        self.max_time = max_time
+        self.stats_getters = stats_getters
+        self.final_check = final_check
+
+    def run(self, cont_if: Optional[Callable]) -> List[SH.Stat]:
+        all_stats = {id(sg): [] for sg in self.stats_getters}
+        for i in range(self.run_count):
+            c = self.p.copy()
+            c.network().rd.set_seed(i)
+            c.init()
+            while True:
+                did_something = c.network().run_ms(10)
+                if self.max_time != 0 and c.network().time >= self.max_time:
+                    break
+                if did_something and (cont_if is None or not cont_if(c)):
+                    break
+            if self.final_check is not None and not self.final_check(c):
+                raise RuntimeError(f"Failed execution of {c} for random seed of {i}")
+            for sg in self.stats_getters:
+                all_stats[id(sg)].append(sg.get(c.network().live_nodes()))
+        return [SH.avg(all_stats[id(sg)]) for sg in self.stats_getters]
+
+    @staticmethod
+    def cont_until_done() -> Callable:
+        """Continue while any live node has doneAt == 0
+        (RunMultipleTimes.java:90-98)."""
+
+        def cont(p) -> bool:
+            return any(n.done_at == 0 for n in p.network().live_nodes())
+
+        return cont
+
+
+class ProgressPerTime:
+    """Per-interval stat series over repeated runs + graph.png
+    (ProgressPerTime.java:16-141)."""
+
+    def __init__(
+        self,
+        template,
+        config_desc: str,
+        y_axis_desc: str,
+        stats_getter: SH.StatsGetter,
+        round_count: int,
+        end_callback: Optional[Callable],
+        stat_each_x_ms: int,
+        verbose: bool = True,
+    ):
+        if round_count <= 0:
+            raise ValueError(f"roundCount must be greater than 0. roundCount={round_count}")
+        self.protocol = template.copy()
+        self.config_desc = config_desc
+        self.y_axis_desc = y_axis_desc
+        self.stats_getter = stats_getter
+        self.round_count = round_count
+        self.end_callback = end_callback
+        self.stat_each_x_ms = stat_each_x_ms
+        self.verbose = verbose
+
+    def run(self, cont_if: Callable, graph_path: Optional[str] = "graph.png"):
+        from ..tools.graph import Graph, ReportLine, Series, stat_series
+
+        raw_results = {f: [] for f in self.stats_getter.fields()}
+        sums = {"bytesSent": 0, "bytesRcv": 0, "msgSent": 0, "msgRcv": 0, "doneAt": 0}
+
+        for r in range(self.round_count):
+            p = self.protocol.copy()
+            p.network().rd.set_seed(r)
+            p.init()
+            if self.verbose:
+                print(f"round={r}, {p} {self.config_desc}")
+            raw_result = {}
+            for f in self.stats_getter.fields():
+                gs = Series()
+                raw_result[f] = gs
+                raw_results[f].append(gs)
+            while True:
+                p.network().run_ms(self.stat_each_x_ms)
+                live_nodes = [n for n in p.network().all_nodes if not n.is_down()]
+                s = self.stats_getter.get(live_nodes)
+                for f in self.stats_getter.fields():
+                    raw_result[f].add_line(ReportLine(p.network().time, s.get(f)))
+                if self.verbose and p.network().time % 10000 == 0:
+                    print(f"time goes by... time={p.network().time // 1000}, stats={s}")
+                if not cont_if(p):
+                    break
+            if self.end_callback is not None:
+                self.end_callback(p)
+            for key, getter in (
+                ("bytesSent", lambda n: n.bytes_sent),
+                ("bytesRcv", lambda n: n.bytes_received),
+                ("msgSent", lambda n: n.msg_sent),
+                ("msgRcv", lambda n: n.msg_received),
+                ("doneAt", lambda n: n.done_at),
+            ):
+                st = SH.get_stats_on(live_nodes, getter)
+                if self.verbose:
+                    print(f"{key}: {st}")
+                sums[key] += st.avg
+
+        if self.verbose and self.round_count > 1:
+            print(f"\nAverage on the {self.round_count} rounds")
+            for key, v in sums.items():
+                print(f"{key}: {v // self.round_count}")
+
+        if graph_path:
+            self.protocol.init()
+            graph = Graph(
+                f"{self.protocol} {self.config_desc}",
+                "time in milliseconds",
+                self.y_axis_desc,
+            )
+            for f in self.stats_getter.fields():
+                ss = stat_series(f, raw_results[f])
+                graph.add_serie(ss.min)
+                graph.add_serie(ss.max)
+                graph.add_serie(ss.avg)
+            graph.clean_series()
+            graph.save(graph_path)
+        return raw_results

@@ -1,0 +1,136 @@
+"""Stats framework.
+
+Reference semantics: core utils/StatsHelper.java — Stat/SimpleStats
+value objects, getStatsOn over node getters, StatsGetter plugin interface,
+and field-by-field integer-average across runs (StatsHelper.avg uses Java
+long division, kept exact here).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+
+class Stat:
+    def fields(self) -> List[str]:
+        raise NotImplementedError
+
+    def get(self, field_name: str) -> int:
+        raise NotImplementedError
+
+    def create_from_value(self, vals: Dict[str, int]) -> "Stat":
+        raise NotImplementedError
+
+
+def avg(stats: Sequence[Stat]) -> Stat:
+    """Field-by-field average, Java integer division (StatsHelper.java:31-54)."""
+    if not stats:
+        raise ValueError("no stats")
+    if len(stats) == 1:
+        return stats[0]
+    vals: Dict[str, int] = {}
+    for f in stats[0].fields():
+        for s in stats:
+            vals[f] = vals.get(f, 0) + s.get(f)
+    n = len(stats)
+    for f in vals:
+        v = vals[f]
+        # Java long division truncates toward zero
+        vals[f] = -((-v) // n) if v < 0 else v // n
+    return stats[0].create_from_value(vals)
+
+
+class Counter(Stat):
+    def __init__(self, val: int):
+        self.count = int(val)
+
+    def fields(self) -> List[str]:
+        return ["count"]
+
+    def get(self, field_name: str) -> int:
+        return self.count
+
+    def create_from_value(self, vals: Dict[str, int]) -> "Counter":
+        return Counter(vals["count"])
+
+    def __repr__(self) -> str:
+        return f"Counter{{count={self.count}}}"
+
+
+class SimpleStats(Stat):
+    def __init__(self, min_: int, max_: int, avg_: int):
+        self.min = int(min_)
+        self.max = int(max_)
+        self.avg = int(avg_)
+
+    def fields(self) -> List[str]:
+        return ["min", "max", "avg"]
+
+    def get(self, field_name: str) -> int:
+        return {"min": self.min, "max": self.max, "avg": self.avg}[field_name]
+
+    def create_from_value(self, vals: Dict[str, int]) -> "SimpleStats":
+        return SimpleStats(vals["min"], vals["max"], vals["avg"])
+
+    def __repr__(self) -> str:
+        return f"min: {self.min}, max:{self.max}, avg:{self.avg}"
+
+
+def get_stats_on(nodes: Sequence, get: Callable) -> SimpleStats:
+    """min/max/avg of a node getter (StatsHelper.java:127-140); avg is Java
+    long division by node count."""
+    mn = 2**63 - 1
+    mx = -(2**63)
+    tot = 0
+    for n in nodes:
+        v = get(n)
+        tot += v
+        mn = min(mn, v)
+        mx = max(mx, v)
+    a = tot // len(nodes) if tot >= 0 else -((-tot) // len(nodes))
+    return SimpleStats(mn, mx, a)
+
+
+def get_done_at(nodes) -> SimpleStats:
+    return get_stats_on(nodes, lambda n: n.done_at)
+
+
+def get_msg_received(nodes) -> SimpleStats:
+    return get_stats_on(nodes, lambda n: n.msg_received)
+
+
+class StatsGetter:
+    def fields(self) -> List[str]:
+        raise NotImplementedError
+
+    def get(self, live_nodes) -> Stat:
+        raise NotImplementedError
+
+
+class SimpleStatsGetter(StatsGetter):
+    def fields(self) -> List[str]:
+        return ["min", "max", "avg"]
+
+
+class DoneAtStatGetter(SimpleStatsGetter):
+    def get(self, live_nodes) -> Stat:
+        return get_done_at(live_nodes)
+
+
+class MsgReceivedStatGetter(SimpleStatsGetter):
+    def get(self, live_nodes) -> Stat:
+        return get_msg_received(live_nodes)
+
+
+class CounterStatsGetter(StatsGetter):
+    """Counts live nodes matching a predicate (the anonymous StatsGetter
+    pattern used in e.g. P2PFlood.floodTime)."""
+
+    def __init__(self, pred: Callable):
+        self._pred = pred
+
+    def fields(self) -> List[str]:
+        return ["count"]
+
+    def get(self, live_nodes) -> Stat:
+        return Counter(sum(1 for n in live_nodes if self._pred(n)))

@@ -1,0 +1,23 @@
+"""The batched time-stepped TPU engine.
+
+This is the TPU-native re-expression of the reference's discrete-event loop
+(core Network.java:318-338 `runMs` / :586 `receiveUntil`): instead of an
+event queue drained one message at a time on one thread, every (replica,
+node) pair applies masked state transitions once per simulated millisecond,
+under `jax.lax.scan`, `jax.vmap` over replicas, and `jax.sharding` over
+devices.
+"""
+
+from .core import BatchedNetwork, Emission, SimState, replicate_state
+from .protocol import BatchedProtocol
+from .rng import hash32, pseudo_delta
+
+__all__ = [
+    "BatchedNetwork",
+    "BatchedProtocol",
+    "Emission",
+    "SimState",
+    "hash32",
+    "pseudo_delta",
+    "replicate_state",
+]

@@ -1,0 +1,343 @@
+"""Batched time-stepped simulation core.
+
+Re-expression of the reference DES (core Network.java) as a synchronous
+per-millisecond state transition suitable for TPUs:
+
+  * node state is a struct-of-arrays pytree of `[N]` columns
+    (Node.java:22-88 fields become columns);
+  * in-flight messages live in a fixed-capacity ring `[C]` of
+    (arrival, from, to, type, payload) with a validity mask — the
+    static-shape analog of MessageStorage (Network.java:116-299);
+  * per-destination latency jitter comes from the reference's own xorshift
+    counter hash (rng.pseudo_delta), so multicast costs no per-dest state,
+    exactly like MultipleDestEnvelope (Envelope.java:46-56);
+  * the event loop is `lax.scan` over milliseconds; one step delivers every
+    due message, runs the protocol's vectorized handlers, fires periodic
+    masks, and appends emissions (receiveUntil/nextMessage,
+    Network.java:533-632, without the queue);
+  * `jax.vmap` over the leading replica axis replaces RunMultipleTimes'
+    sequential reseeded runs (RunMultipleTimes.java:48-63).
+
+Semantics deltas vs the oracle (documented, by design — SURVEY §7):
+  * same-millisecond deliveries are simultaneous (no LIFO order inside a
+    ms); protocols must use commutative per-tick updates;
+  * `run_ms(ms)` processes ticks [time, time+ms) — arrivals at exactly
+    time+ms land at the start of the next call (the oracle includes the
+    boundary tick in the earlier call);
+  * randomness is counter-based, so message *distributions* match the
+    oracle but individual draws differ.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.latency import LatencyStatic, NetworkLatency, vec_latency
+from .rng import hash32, pseudo_delta
+
+MAX_PARTITIONS = 4
+INT_MAX = np.int32(2**31 - 1)
+
+
+class SimState(NamedTuple):
+    """Per-replica simulation state; every field is a jnp array so the whole
+    thing is a pytree (checkpointable for free — an upgrade over the
+    reference, whose Envelope.java:55 only muses about serialization)."""
+
+    time: jnp.ndarray  # int32 scalar, ms (Network.java:46-49)
+    seed: jnp.ndarray  # int32 scalar, per-replica base seed
+    send_ctr: jnp.ndarray  # int32 scalar: per-send-event counter (seeds)
+    # node columns (Node.java:22-88)
+    down: jnp.ndarray  # bool[N]
+    done_at: jnp.ndarray  # int32[N]
+    msg_received: jnp.ndarray  # int32[N]
+    msg_sent: jnp.ndarray  # int32[N]
+    bytes_received: jnp.ndarray  # int32[N]
+    bytes_sent: jnp.ndarray  # int32[N]
+    # latency inputs (per replica so vmap covers heterogeneous layouts)
+    x: jnp.ndarray  # int32[N]
+    y: jnp.ndarray  # int32[N]
+    extra_latency: jnp.ndarray  # int32[N]
+    city_idx: jnp.ndarray  # int32[N]
+    # partitions (Network.java:639-707)
+    partition_x: jnp.ndarray  # int32[MAX_PARTITIONS], INT_MAX = unused
+    # message ring
+    msg_valid: jnp.ndarray  # bool[C]
+    msg_arrival: jnp.ndarray  # int32[C]
+    msg_from: jnp.ndarray  # int32[C]
+    msg_to: jnp.ndarray  # int32[C]
+    msg_type: jnp.ndarray  # int32[C]
+    msg_payload: jnp.ndarray  # int32[C, P]
+    msg_head: jnp.ndarray  # int32 scalar: next write cursor
+    dropped: jnp.ndarray  # int32 scalar: ring-overflow count (must stay 0)
+    proto: Any  # protocol-defined pytree
+
+
+@dataclasses.dataclass
+class Emission:
+    """A batched send request: K candidate messages (the analog of one
+    Network.send call, Network.java:341-447).
+
+    mask[K] selects real sends; from_idx/to_idx[K] are node ids; payload is
+    [K, P] (or None when P=0).  arrival, when given, bypasses the latency
+    model AND sender counters (the analog of sendArriveAt,
+    Network.java:419-422, used for task-style self-messages); declare such
+    types with msg_size 0 so receiver counters skip them too."""
+
+    mask: jnp.ndarray
+    from_idx: jnp.ndarray
+    to_idx: jnp.ndarray
+    mtype: int
+    payload: Optional[jnp.ndarray] = None
+    send_time: Optional[jnp.ndarray] = None  # default: state.time + 1
+    arrival: Optional[jnp.ndarray] = None  # explicit arrival times [K]
+
+
+class BatchedNetwork:
+    """The engine: binds a latency model + protocol to compiled step/run
+    functions.  One instance is reusable across replica counts (everything
+    batched lives in SimState)."""
+
+    def __init__(
+        self,
+        protocol: "BatchedProtocol",
+        latency: NetworkLatency,
+        n_nodes: int,
+        capacity: int = 1 << 14,
+        msg_discard_time: int = int(INT_MAX),
+    ):
+        self.protocol = protocol
+        self.latency = latency
+        self.n_nodes = n_nodes
+        self.capacity = capacity
+        self.msg_discard_time = msg_discard_time
+        self.payload_width = protocol.PAYLOAD_WIDTH
+        sizes = [protocol.msg_size(t) for t in range(protocol.n_msg_types())]
+        self._msg_sizes = np.asarray(sizes, dtype=np.int32)
+
+    # -- state construction (host-side) -------------------------------------
+    def init_state(self, cols: dict, seed: int, proto: Any, down=None) -> SimState:
+        """Build a fresh single-replica state from node columns
+        (core.node.build_node_columns output).  `down` marks nodes dead from
+        t=0 — applied before the protocol's initial emissions so sends to
+        them are dropped like the oracle's send-time check."""
+        n, c, p = self.n_nodes, self.capacity, self.payload_width
+        zi = lambda shape: jnp.zeros(shape, dtype=jnp.int32)
+        state = SimState(
+            time=jnp.int32(0),
+            seed=jnp.int32(np.int64(seed) & 0x7FFFFFFF),
+            send_ctr=jnp.int32(0),
+            down=(
+                jnp.zeros(n, dtype=bool)
+                if down is None
+                else jnp.asarray(down, dtype=bool)
+            ),
+            done_at=zi(n),
+            msg_received=zi(n),
+            msg_sent=zi(n),
+            bytes_received=zi(n),
+            bytes_sent=zi(n),
+            x=jnp.asarray(cols["x"], jnp.int32),
+            y=jnp.asarray(cols["y"], jnp.int32),
+            extra_latency=jnp.asarray(cols["extra_latency"], jnp.int32),
+            city_idx=jnp.asarray(cols.get("city_idx", np.full(n, -1)), jnp.int32),
+            partition_x=jnp.full(MAX_PARTITIONS, INT_MAX, dtype=jnp.int32),
+            msg_valid=jnp.zeros(c, dtype=bool),
+            msg_arrival=jnp.full(c, INT_MAX, dtype=jnp.int32),
+            msg_from=zi(c),
+            msg_to=zi(c),
+            msg_type=zi(c),
+            msg_payload=zi((c, p)),
+            msg_head=jnp.int32(0),
+            dropped=jnp.int32(0),
+            proto=proto,
+        )
+        for em in self.protocol.initial_emissions(self, state):
+            state = self.apply_emission(state, em)
+        return state
+
+    # -- partitions (Network.partition, Network.java:693-707) ----------------
+    @staticmethod
+    def partition_id(state: SimState, x_col) -> jnp.ndarray:
+        """pid = number of partition lines at or left of the node
+        (Network.partitionId, Network.java:639-649)."""
+        return jnp.sum(
+            state.partition_x[None, :] <= x_col[:, None], axis=-1
+        ).astype(jnp.int32)
+
+    # -- the send path (createMessageArrival, Network.java:469-487) ----------
+    def apply_emission(self, state: SimState, em: Emission) -> SimState:
+        k = em.mask.shape[0]
+        send_time = em.send_time if em.send_time is not None else state.time + 1
+        mask = em.mask
+        from_idx = em.from_idx.astype(jnp.int32)
+        to_idx = em.to_idx.astype(jnp.int32)
+
+        if em.arrival is not None:
+            # sendArriveAt path: explicit arrival, no latency model and no
+            # sender counters (Network.sendArriveAt, Network.java:419-422,
+            # bypasses createMessageArrival's counter ticks)
+            arrival = em.arrival.astype(jnp.int32)
+            ok = mask
+        else:
+            # sender counters tick even for dropped/partitioned messages
+            # (Network.java:476-477 increments before the partition check)
+            size = jnp.int32(self._msg_sizes[em.mtype])
+            state = state._replace(
+                msg_sent=state.msg_sent.at[from_idx].add(mask.astype(jnp.int32)),
+                bytes_sent=state.bytes_sent.at[from_idx].add(
+                    mask.astype(jnp.int32) * size
+                ),
+            )
+            # per-event seed: the batched analog of rd.nextInt() per send;
+            # send_ctr + row index decorrelate same-tick same-type sends
+            seed = hash32(
+                state.seed,
+                send_time,
+                from_idx,
+                jnp.int32(em.mtype),
+                state.send_ctr,
+                jnp.arange(k, dtype=jnp.int32),
+            )
+            delta = pseudo_delta(to_idx, seed)
+            static = LatencyStatic(state.x, state.y, state.extra_latency, state.city_idx)
+            lat = vec_latency(self.latency, static, from_idx, to_idx, delta)
+            arrival = send_time + lat
+            pid_f = self.partition_id(state, state.x[from_idx])
+            pid_t = self.partition_id(state, state.x[to_idx])
+            ok = (
+                mask
+                & ~state.down[from_idx]
+                & ~state.down[to_idx]
+                & (pid_f == pid_t)
+                & (lat < self.msg_discard_time)
+            )
+
+        # pack the ok-messages into ring slots [head, head+n_ok) (mod C)
+        slot_rank = jnp.cumsum(ok.astype(jnp.int32)) - 1
+        pos = lax.rem(state.msg_head + slot_rank, jnp.int32(self.capacity))
+        pos = jnp.where(ok, pos, jnp.int32(self.capacity))  # OOB -> dropped
+        n_ok = jnp.sum(ok.astype(jnp.int32))
+        overwritten = jnp.sum(
+            (state.msg_valid.at[pos].get(mode="fill", fill_value=False) & ok).astype(
+                jnp.int32
+            )
+        )
+        # overflow accounting: slots overwritten while still valid, plus
+        # intra-emission slot collisions when one emission exceeds capacity
+        overwritten = overwritten + jnp.maximum(
+            0, n_ok - jnp.int32(self.capacity)
+        )
+        payload = em.payload
+        if self.payload_width and payload is None:
+            payload = jnp.zeros((k, self.payload_width), dtype=jnp.int32)
+        new = state._replace(
+            msg_valid=state.msg_valid.at[pos].set(True, mode="drop"),
+            msg_arrival=state.msg_arrival.at[pos].set(arrival, mode="drop"),
+            msg_from=state.msg_from.at[pos].set(from_idx, mode="drop"),
+            msg_to=state.msg_to.at[pos].set(to_idx, mode="drop"),
+            msg_type=state.msg_type.at[pos].set(jnp.int32(em.mtype), mode="drop"),
+            msg_head=lax.rem(state.msg_head + n_ok, jnp.int32(self.capacity)),
+            dropped=state.dropped + overwritten,
+            send_ctr=state.send_ctr + 1,
+        )
+        if self.payload_width:
+            new = new._replace(
+                msg_payload=new.msg_payload.at[pos].set(payload, mode="drop")
+            )
+        return new
+
+    def apply_emissions(self, state: SimState, emissions) -> SimState:
+        for em in emissions:
+            state = self.apply_emission(state, em)
+        return state
+
+    # -- one millisecond (receiveUntil body, Network.java:586-632) -----------
+    def step(self, state: SimState) -> SimState:
+        t = state.time
+        due = state.msg_valid & (state.msg_arrival <= t)
+        # delivery-time checks: down destination or cross-partition messages
+        # are discarded on arrival (Network.java:606, :518-520)
+        pid_f = self.partition_id(state, state.x[state.msg_from])
+        pid_t = self.partition_id(state, state.x[state.msg_to])
+        deliver = due & ~state.down[state.msg_to] & (pid_f == pid_t)
+
+        # receiver counters skip size-0 (task-style) types, mirroring the
+        # Task exemption at Network.java:522-526
+        sizes = jnp.asarray(self._msg_sizes, jnp.int32)[state.msg_type]
+        dm = (deliver & (sizes > 0)).astype(jnp.int32)
+        state = state._replace(
+            msg_received=state.msg_received.at[state.msg_to].add(dm, mode="drop"),
+            bytes_received=state.bytes_received.at[state.msg_to].add(
+                dm * sizes, mode="drop"
+            ),
+        )
+
+        state, emissions = self.protocol.deliver(self, state, deliver)
+        state = state._replace(msg_valid=state.msg_valid & ~due)
+        state = self.apply_emissions(state, emissions)
+
+        state = self.protocol.tick(self, state)
+        return state._replace(time=state.time + 1)
+
+    def _step_jump(self, state: SimState, end) -> SimState:
+        """step() plus empty-ms skipping: when the protocol has no per-ms
+        tick work (TICK_INTERVAL None), jump straight to the next arrival —
+        the batched analog of the oracle's event loop skipping idle time
+        (nextMessage's per-ms poll, Network.java:533-545, exists only
+        because conditional tasks poll empty milliseconds)."""
+        state = self.step(state)
+        if self.protocol.TICK_INTERVAL is None:
+            next_arrival = jnp.min(
+                jnp.where(state.msg_valid, state.msg_arrival, INT_MAX)
+            )
+            t = jnp.clip(next_arrival, state.time, end).astype(jnp.int32)
+            state = state._replace(time=t)
+        return state
+
+    # -- the loop ------------------------------------------------------------
+    @functools.partial(jax.jit, static_argnums=(0, 2))
+    def run_ms(self, state: SimState, ms: int) -> SimState:
+        """Advance `ms` simulated milliseconds (ticks [time, time+ms))."""
+        end = state.time + ms
+
+        def cond(s):
+            return s.time < end
+
+        def body(s):
+            return self._step_jump(s, end)
+
+        state = lax.while_loop(cond, body, state)
+        return state._replace(time=end)
+
+    @functools.partial(jax.jit, static_argnums=(0, 2))
+    def run_ms_batched(self, states: SimState, ms: int) -> SimState:
+        """vmapped run over the leading replica axis — the TPU replacement
+        for RunMultipleTimes' sequential reseeded loop."""
+        return jax.vmap(lambda s: self.run_ms(s, ms))(states)
+
+
+def replicate_state(state: SimState, n_replicas: int, seeds=None) -> SimState:
+    """Tile a single-replica state along a new leading replica axis, giving
+    each replica its own dynamics seed.  (Distinct node layouts per replica
+    can be had by stacking init_state outputs instead.)"""
+    if seeds is None:
+        seeds = np.arange(n_replicas, dtype=np.int32)
+    seeds = jnp.asarray(seeds, jnp.int32)
+    tiled = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a, (n_replicas,) + a.shape), state
+    )
+    return tiled._replace(seed=seeds)
+
+
+def stack_states(states) -> SimState:
+    """Stack independently-built single-replica states (heterogeneous node
+    layouts, the exact analog of RunMultipleTimes' per-seed re-init)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)

@@ -1,0 +1,62 @@
+"""Batched protocol contract.
+
+The batched analog of core Protocol.java + Message.action: a protocol is a
+set of vectorized kernels over the SoA state instead of per-object
+callbacks.  `deliver` sees ALL due messages at once (masked rows of the
+message ring) and must apply commutative updates; `tick` hosts
+periodic-task masks ((t - start) % period == 0 — PeriodicTask.java:40-47
+without the queue) and conditional-task predicates (Network.java:543-566)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+
+
+class BatchedProtocol:
+    """Subclass and override.  MSG_TYPES maps message-type names to the int
+    codes stored in the ring."""
+
+    MSG_TYPES: List[str] = []
+    PAYLOAD_WIDTH: int = 0
+    # None = tick() does nothing time-sensitive, so the engine may skip
+    # empty milliseconds (jump to the next arrival).  Protocols with
+    # periodic/conditional work must set 1 (or their smallest period).
+    TICK_INTERVAL: int | None = 1
+
+    def n_msg_types(self) -> int:
+        return max(1, len(self.MSG_TYPES))
+
+    def mtype(self, name: str) -> int:
+        return self.MSG_TYPES.index(name)
+
+    def msg_size(self, mtype: int) -> int:
+        """Bytes per message type (Message.size, Message.java:28 default 1)."""
+        return 1
+
+    # -- hooks ---------------------------------------------------------------
+    def proto_init(self, n_nodes: int) -> Any:
+        """Protocol-state pytree for a fresh replica (Protocol.init)."""
+        return ()
+
+    def initial_emissions(self, net, state) -> List:
+        """Messages injected at t=0 (the protocol's init() sends)."""
+        return []
+
+    def deliver(self, net, state, deliver_mask) -> Tuple[Any, List]:
+        """Handle all due messages.  Returns (new state, emissions) — the
+        state may update proto and node columns (done_at, down, ...) but must
+        not touch msg_* (the engine owns the ring).  `deliver_mask` is
+        bool[C] over the message ring; read message fields from state.msg_*."""
+        return state, []
+
+    def tick(self, net, state):
+        """Per-millisecond hook after delivery (periodic/conditional tasks).
+        Returns the full state (may emit via net.apply_emission)."""
+        return state
+
+    # -- termination ----------------------------------------------------------
+    def all_done(self, state) -> jnp.ndarray:
+        """bool scalar: replica finished (used by sweep drivers to stop)."""
+        return jnp.asarray(False)

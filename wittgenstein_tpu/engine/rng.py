@@ -1,0 +1,57 @@
+"""Counter-based RNG for the batched engine.
+
+The reference derives per-destination latency jitter from a single random
+seed and the destination id via an xorshift hash (Network.getPseudoRandom,
+Network.java:493-503) precisely so that one multicast envelope never has to
+store per-destination state.  That trick *is* counter-based RNG, so the
+batched engine keeps the exact same hash, vectorized, and derives the
+per-event seeds from (replica_seed, time, stream, counter) with a murmur3
+finalizer instead of a sequential java.util.Random stream (which cannot be
+consumed in parallel).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _i32(x):
+    return jnp.asarray(x).astype(jnp.int32)
+
+
+def pseudo_delta(dest_id, seed):
+    """Deterministic delta in [0, 99] from (destId, seed) — bit-exact
+    vectorization of Network.getPseudoRandom (Network.java:493-503)."""
+    a = _i32(dest_id)
+    a = a ^ (a << 13)
+    a = a ^ lax.shift_right_logical(a, 17)
+    a = a ^ (a << 5)
+    x = a ^ _i32(seed)
+    return jnp.abs(lax.rem(x, jnp.int32(100)))
+
+
+def _mix32(x):
+    """murmur3 fmix32 avalanche on uint32."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash32(*parts):
+    """Combine integer parts into one well-mixed int32 (the batched stand-in
+    for `rd.nextInt()` seeds; order-sensitive, collision-resistant)."""
+    h = jnp.uint32(0x9E3779B9)
+    for p in parts:
+        p = jnp.asarray(p).astype(jnp.uint32)
+        h = _mix32(h ^ (p + jnp.uint32(0x9E3779B9) + (h << 6) + (h >> 2)))
+    return h.astype(jnp.int32)
+
+
+def uniform_u01(*parts):
+    """Deterministic float32 uniform in [0, 1) from integer parts."""
+    bits = hash32(*parts).astype(jnp.uint32) >> jnp.uint32(8)
+    return bits.astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))

@@ -1,0 +1,92 @@
+"""P2PFlood: libp2p-style flood routing on a random P2P graph.
+
+Reference semantics: protocols/P2PFlood.java — dead nodes stay in peer
+lists but neither send nor receive (a byzantine-ish availability lie);
+`msgCount` random live senders each flood one message; a node is done when
+it has received `msgCount` distinct flood messages (P2PFlood.java:39-43).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..core.params import WParameters, register_protocol
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..oracle.messages import FloodMessage
+from ..oracle.network import Protocol
+from ..oracle.p2p import P2PNetwork, P2PNode
+
+
+@dataclasses.dataclass
+class P2PFloodParameters(WParameters):
+    node_count: int = 100
+    dead_node_count: int = 10
+    delay_before_resent: int = 50
+    msg_count: int = 1
+    msg_to_receive: int = 1
+    peers_count: int = 10
+    delay_between_sends: int = 30
+    node_builder_name: Optional[str] = None
+    network_latency_name: Optional[str] = None
+
+
+class P2PFloodNode(P2PNode):
+    __slots__ = ("_params", "_net")
+
+    def __init__(self, network, nb, down: bool, params):
+        super().__init__(network.rd, nb)
+        self._params = params
+        self._net = network
+        if down:
+            self.stop()
+
+    def on_flood(self, from_node, flood_message) -> None:
+        if len(self.get_msg_received(flood_message.msg_id())) == self._params.msg_count:
+            self.done_at = self._net.time
+
+
+@register_protocol("P2PFlood", P2PFloodParameters)
+class P2PFlood(Protocol):
+    def __init__(self, params: P2PFloodParameters):
+        self.params = params
+        self._network: P2PNetwork[P2PFloodNode] = P2PNetwork(params.peers_count, True)
+        self.nb = registry_node_builders.get_by_name(params.node_builder_name)
+        self._network.set_network_latency(
+            registry_network_latencies.get_by_name(params.network_latency_name)
+        )
+
+    def __str__(self) -> str:
+        p, net = self.params, self._network
+        return (
+            f"nodes={p.node_count}, deadNodes={p.dead_node_count}"
+            f", delayBeforeResent={p.delay_before_resent}ms, msgSent={p.msg_count}"
+            f", msgToReceive={p.msg_to_receive}, peers(minimum)={p.peers_count}"
+            f", peers(avg)={net.avg_peers()}, delayBetweenSends={p.delay_between_sends}ms"
+            f", latency={type(net.network_latency).__name__}"
+        )
+
+    def copy(self) -> "P2PFlood":
+        return P2PFlood(self.params)
+
+    def init(self) -> None:
+        p = self.params
+        for i in range(p.node_count):
+            self._network.add_node(
+                P2PFloodNode(self._network, self.nb, i < p.dead_node_count, p)
+            )
+        self._network.set_peers()
+
+        senders: set = set()
+        while len(senders) < p.msg_count:
+            node_id = self._network.rd.next_int(p.node_count)
+            from_node = self._network.get_node_by_id(node_id)
+            if not from_node.is_down() and node_id not in senders:
+                senders.add(node_id)
+                m = FloodMessage(1, p.delay_before_resent, p.delay_between_sends)
+                self._network.send_peers(m, from_node)
+                if p.msg_count == 1:
+                    from_node.done_at = 1
+
+    def network(self) -> P2PNetwork:
+        return self._network

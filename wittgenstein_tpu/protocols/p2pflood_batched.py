@@ -1,0 +1,156 @@
+"""Batched P2PFlood: flood routing as masked frontier propagation.
+
+Same behavior as protocols/P2PFlood.java on the batched engine:
+
+  * the random graph is built host-side by the oracle P2PNetwork (same
+    JavaRandom stream → identical topology) and baked into a padded
+    `[N, max_peers]` adjacency array;
+  * dedup-and-forward (messages/FloodMessage.java:47-56) becomes a
+    per-tick "winner" reduction: of all ring slots delivering the same
+    (node, flood) pair this millisecond, the lowest slot wins, marks the
+    pair received, and forwards to every peer except the winning sender —
+    per-tick work scales with ring capacity × max_peers, not N × M;
+  * doneAt is set when a node holds msg_count distinct floods
+    (P2PFlood.java:39-43).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.node import build_node_columns
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..engine import BatchedNetwork, BatchedProtocol, Emission
+from .p2pflood import P2PFlood, P2PFloodParameters
+
+
+def build_adjacency(net) -> np.ndarray:
+    """Pad the oracle P2P graph into [N, max_degree] int32, -1 = no peer."""
+    degrees = [len(n.peers) for n in net.all_nodes]
+    max_deg = max(degrees) if degrees else 0
+    adj = np.full((len(net.all_nodes), max_deg), -1, dtype=np.int32)
+    for i, n in enumerate(net.all_nodes):
+        for j, p in enumerate(n.peers):
+            adj[i, j] = p.node_id
+    return adj
+
+
+class BatchedP2PFlood(BatchedProtocol):
+    MSG_TYPES = ["FLOOD"]
+    PAYLOAD_WIDTH = 1  # flood id
+    TICK_INTERVAL = None  # pure message protocol: engine may skip empty ms
+
+    def __init__(self, params: P2PFloodParameters, adjacency: np.ndarray, senders):
+        self.params = params
+        self.adj = jnp.asarray(adjacency, jnp.int32)
+        self.senders = list(senders)  # flood id -> origin node id
+        self.n_nodes = params.node_count
+        self.n_floods = len(self.senders)
+
+    def msg_size(self, mtype: int) -> int:
+        return 1  # FloodMessage(1, ...) in P2PFlood.init
+
+    def proto_init(self, n_nodes: int):
+        received = jnp.zeros((n_nodes, self.n_floods), dtype=bool)
+        # senders pre-mark their own message (sendPeers -> addToReceived)
+        received = received.at[
+            jnp.asarray(self.senders, jnp.int32), jnp.arange(self.n_floods)
+        ].set(True)
+        return {"received": received}
+
+    # -- helpers -------------------------------------------------------------
+    def _forward(self, state, src, fid, mask, exclude):
+        """Emission: src[K] forwards flood fid[K] to all its peers except
+        `exclude[K]`, with FloodMessage local/per-peer delays."""
+        p = self.params
+        k = src.shape[0]
+        n_peers = self.adj.shape[1]
+        src_r = jnp.repeat(src, n_peers)
+        fid_r = jnp.repeat(fid, n_peers)
+        mask_r = jnp.repeat(mask, n_peers)
+        dest = self.adj[src].reshape(-1)
+        excl_r = jnp.repeat(exclude, n_peers)
+        ok = mask_r & (dest >= 0) & (dest != excl_r)
+        # sendPeers/_send_multi spacing: k-th destination leaves at
+        # base + k*(delay+1) when delay_between_sends > 0 (Network.java:449-467)
+        base = state.time + 1 + p.delay_before_resent
+        rank = jnp.tile(jnp.arange(n_peers, dtype=jnp.int32), (k,))
+        spacing = (p.delay_between_sends + 1) if p.delay_between_sends > 0 else 0
+        send_time = jnp.broadcast_to(base, rank.shape) + rank * spacing
+        return Emission(
+            mask=ok,
+            from_idx=src_r,
+            to_idx=jnp.maximum(dest, 0),
+            mtype=self.mtype("FLOOD"),
+            payload=fid_r[:, None],
+            send_time=send_time,
+        )
+
+    def initial_emissions(self, net, state):
+        src = jnp.asarray(self.senders, jnp.int32)
+        fid = jnp.arange(self.n_floods, dtype=jnp.int32)
+        mask = jnp.ones(self.n_floods, dtype=bool)
+        exclude = jnp.full(self.n_floods, -1, jnp.int32)  # senders flood all peers
+        # sendPeers base time is time+1+localDelay with time=0 (P2PNetwork.java:127-133)
+        return [self._forward(state, src, fid, mask, exclude)]
+
+    def deliver(self, net, state, deliver_mask):
+        c = deliver_mask.shape[0]
+        to = state.msg_to
+        fid = state.msg_payload[:, 0]
+        received = state.proto["received"]
+        fresh = deliver_mask & ~received[to, fid]
+
+        # winner per (node, flood): lowest delivering slot this tick
+        slot = jnp.arange(c, dtype=jnp.int32)
+        winner = jnp.full((self.n_nodes, self.n_floods), c, jnp.int32)
+        winner = winner.at[to, fid].min(jnp.where(fresh, slot, c), mode="drop")
+        is_winner = fresh & (winner[to, fid] == slot)
+
+        received = received.at[to, fid].max(fresh, mode="drop")
+        count = jnp.sum(received, axis=1).astype(jnp.int32)
+        # onFlood: done when msg_count distinct messages held (P2PFlood.java:39-43)
+        done = (count >= self.params.msg_count) & (state.done_at == 0) & ~state.down
+        done_at = jnp.where(done, state.time, state.done_at)
+
+        em = self._forward(state, to, fid, is_winner, state.msg_from)
+        state = state._replace(proto={"received": received}, done_at=done_at)
+        return state, [em]
+
+    def all_done(self, state):
+        live = ~state.down
+        return jnp.all(jnp.where(live, state.done_at > 0, True))
+
+
+def make_p2pflood(params: Optional[P2PFloodParameters] = None, capacity: int = 1 << 13, seed: int = 0):
+    """Host-side construction: run the oracle init() for the graph + sender
+    choice (same RNG stream), then bake into the batched engine."""
+    params = params or P2PFloodParameters()
+    oracle = P2PFlood(params)
+    oracle.init()
+    net_o = oracle.network()
+    adj = build_adjacency(net_o)
+    # oracle sender order: nodes whose own message is pre-marked received
+    senders = [
+        n.node_id for n in net_o.all_nodes if len(n.get_msg_received(-1)) > 0
+    ]
+    latency = registry_network_latencies.get_by_name(params.network_latency_name)
+    city_index = getattr(latency, "city_index", None)
+    cols = build_node_columns(net_o.all_nodes, city_index)
+    proto = BatchedP2PFlood(params, adj, senders)
+    net = BatchedNetwork(proto, latency, params.node_count, capacity=capacity)
+    # dead nodes are down from t=0 (P2PFloodNode ctor stop()), before the
+    # initial floods go out
+    down = np.array([n.is_down() for n in net_o.all_nodes])
+    state = net.init_state(
+        cols, seed=seed, proto=proto.proto_init(params.node_count), down=down
+    )
+    if params.msg_count == 1:
+        # the single sender is done at t=1 (P2PFlood.init)
+        done0 = np.zeros(params.node_count, dtype=np.int32)
+        done0[senders[0]] = 1
+        state = state._replace(done_at=jnp.asarray(done0))
+    return net, state

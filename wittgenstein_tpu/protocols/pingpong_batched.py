@@ -1,0 +1,89 @@
+"""Batched PingPong: the canonical first protocol on the TPU engine.
+
+Same behavior as protocols/PingPong.java — a witness Pings everyone, each
+node Pongs back, the witness counts pongs — expressed as two vectorized
+message kernels instead of per-object callbacks."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.node import build_node_columns
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..engine import BatchedNetwork, BatchedProtocol, Emission
+from ..utils.javarand import JavaRandom
+
+
+class BatchedPingPong(BatchedProtocol):
+    MSG_TYPES = ["PING", "PONG"]
+    TICK_INTERVAL = None  # pure message protocol: engine may skip empty ms
+
+    def __init__(self, n_nodes: int, witness: int = 0):
+        self.n_nodes = n_nodes
+        self.witness = witness
+
+    def proto_init(self, n_nodes: int):
+        return {"pong": jnp.zeros(n_nodes, dtype=jnp.int32)}
+
+    def initial_emissions(self, net, state):
+        # network.sendAll(new Ping(), witness) at t=0 -> sendTime 1
+        n = self.n_nodes
+        return [
+            Emission(
+                mask=jnp.ones(n, dtype=bool),
+                from_idx=jnp.full(n, self.witness, dtype=jnp.int32),
+                to_idx=jnp.arange(n, dtype=jnp.int32),
+                mtype=self.mtype("PING"),
+                send_time=jnp.int32(1),
+            )
+        ]
+
+    def deliver(self, net, state, deliver_mask):
+        ping = deliver_mask & (state.msg_type == self.mtype("PING"))
+        pong = deliver_mask & (state.msg_type == self.mtype("PONG"))
+        # on_ping: reply Pong to the sender (PingPong.java onPing)
+        emissions = [
+            Emission(
+                mask=ping,
+                from_idx=state.msg_to,
+                to_idx=state.msg_from,
+                mtype=self.mtype("PONG"),
+            )
+        ]
+        # on_pong: count (commutative scatter-add)
+        new_pong = state.proto["pong"].at[state.msg_to].add(
+            pong.astype(jnp.int32), mode="drop"
+        )
+        return state._replace(proto={"pong": new_pong}), emissions
+
+    def all_done(self, state):
+        return state.proto["pong"][self.witness] >= self.n_nodes
+
+
+def make_pingpong(
+    node_ct: int = 1000,
+    node_builder_name: Optional[str] = None,
+    network_latency_name: Optional[str] = None,
+    capacity: Optional[int] = None,
+    seed: int = 0,
+):
+    """Host-side construction mirroring PingPong.init(): build the node
+    population with the same JavaRandom stream as the oracle, convert to SoA
+    columns, return (net, state)."""
+    nb = registry_node_builders.get_by_name(node_builder_name)
+    latency = registry_network_latencies.get_by_name(network_latency_name)
+    rd = JavaRandom(0)
+    from ..core.node import Node
+
+    nodes = [Node(rd, nb) for _ in range(node_ct)]
+    city_index = getattr(latency, "city_index", None)
+    cols = build_node_columns(nodes, city_index)
+    proto = BatchedPingPong(node_ct)
+    cap = capacity if capacity is not None else 2 * node_ct + 64
+    net = BatchedNetwork(proto, latency, node_ct, capacity=cap)
+    state = net.init_state(cols, seed=seed, proto=proto.proto_init(node_ct))
+    return net, state
