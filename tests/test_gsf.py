@@ -1,0 +1,100 @@
+"""GSFSignature conformance tests, ported from GSFSignatureTest.java."""
+
+import pytest
+
+from wittgenstein_tpu.core.registries import builder_name
+from wittgenstein_tpu.protocols.gsf import GSFSignature, GSFSignatureParameters
+
+NL = "NetworkLatencyByDistanceWJitter"
+NB = builder_name("RANDOM", True, 0)
+
+
+def _card(bits):
+    return bits.bit_count()
+
+
+@pytest.fixture
+def p32():
+    p = GSFSignature(
+        GSFSignatureParameters(32, 1, 3, 20, 10, 10, 0, NB, NL)
+    )
+    p.init()
+    return p
+
+
+class TestGSFInit:
+    def test_init(self, p32):
+        n0 = p32.network().get_node_by_id(0)
+        assert len(n0.levels) == 6
+        assert [len(l.peers) for l in n0.levels] == [0, 1, 2, 4, 8, 16]
+        assert n0.levels[1].peers[0].node_id == 1
+        assert [_card(l.verified_signatures) for l in n0.levels] == [1, 0, 0, 0, 0, 0]
+
+    def test_max_sig_in_level(self, p32):
+        n0 = p32.network().get_node_by_id(0)
+        assert [l.expected_sigs() for l in n0.levels] == [1, 1, 2, 4, 8, 16]
+
+    def test_send(self, p32):
+        p32.network().run_ms(1)
+        # each node sent its signature to one peer (+ 32 periodic tasks)
+        assert p32.network().msgs.size() == 64
+
+    def test_dead_nodes(self):
+        p = GSFSignature(
+            GSFSignatureParameters(32, 0.8, 3, 20, 10, 10, 0.1, NB, NL)
+        )
+        p.init()
+        dead = sum(1 for n in p.network().all_nodes if n.is_down())
+        assert dead == 3
+
+    def test_get_last_finished_level(self, p32):
+        n0 = p32.network().get_node_by_id(0)
+        assert _card(n0.get_last_finished_level()) == 1
+        n0.levels[1].verified_signatures |= n0.levels[1].waited_sigs
+        assert _card(n0.get_last_finished_level()) == 2
+        n0.levels[2].verified_signatures |= 1 << 2
+        assert _card(n0.get_last_finished_level()) == 2
+        n0.levels[2].verified_signatures |= 1 << 3
+        assert _card(n0.get_last_finished_level()) == 4
+
+
+class TestGSFRuns:
+    def test_simple_run(self):
+        p = GSFSignature(
+            GSFSignatureParameters(32, 1, 3, 20, 10, 10, 0, NB, NL)
+        )
+        p.init()
+        p.network().run(10)
+        assert len(p.network().all_nodes) == 32
+        for n in p.network().all_nodes:
+            assert _card(n.verified_signatures) == 32
+
+    def test_simple_threshold(self):
+        p = GSFSignature(
+            GSFSignatureParameters(64, 0.50, 3, 20, 10, 10, 0.2, NB, NL)
+        )
+        p.init()
+        p.network().run(10)
+        assert len(p.network().all_nodes) == 64
+        for n in p.network().all_nodes:
+            if n.is_down():
+                assert _card(n.verified_signatures) == 1
+            else:
+                assert 32 <= _card(n.verified_signatures) <= 64
+
+    def test_copy(self):
+        p1 = GSFSignature(
+            GSFSignatureParameters(128, 0.75, 6, 10, 5, 10, 0.2, NB, NL)
+        )
+        p2 = p1.copy()
+        p1.init()
+        p2.init()
+        while p1.network().time < 2000:
+            p1.network().run_ms(200)
+            p2.network().run_ms(200)
+            assert p1.network().msgs.size() == p2.network().msgs.size()
+            for n1 in p1.network().all_nodes:
+                n2 = p2.network().get_node_by_id(n1.node_id)
+                assert n1.done_at == n2.done_at
+                assert n1.verified_signatures == n2.verified_signatures
+                assert len(n1.to_verify) == len(n2.to_verify)

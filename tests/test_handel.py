@@ -1,0 +1,107 @@
+"""Handel conformance tests (ported from HandelTest.java), plus structure
+and attack-scenario checks."""
+
+from wittgenstein_tpu.core.registries import builder_name
+from wittgenstein_tpu.core.runners import RunMultipleTimes
+from wittgenstein_tpu.protocols.handel import Handel, HandelParameters
+
+NL = "NetworkLatencyByDistanceWJitter"
+NB = builder_name("RANDOM", True, 0)
+
+
+def make_params(**kw):
+    base = dict(
+        node_count=64,
+        threshold=60,
+        pairing_time=6,
+        level_wait_time=10,
+        extra_cycle=5,
+        dissemination_period_ms=5,
+        fast_path=10,
+        nodes_down=2,
+        node_builder_name=NB,
+        network_latency_name=NL,
+        desynchronized_start=100,
+    )
+    base.update(kw)
+    return HandelParameters(**base)
+
+
+class TestHandel:
+    def test_copy(self):
+        """HandelTest.testCopy: identical same-seed runs."""
+        p1 = Handel(make_params())
+        p2 = p1.copy()
+        p1.init()
+        p2.init()
+        while p1.network().time < 2000:
+            p1.network().run_ms(100)
+            p2.network().run_ms(100)
+            assert p1.network().msgs.size() == p2.network().msgs.size()
+            for n1 in p1.network().all_nodes:
+                n2 = p2.network().get_node_by_id(n1.node_id)
+                assert n1.done_at == n2.done_at
+                assert n1.total_sig_size() == n2.total_sig_size()
+
+    def test_run(self):
+        """HandelTest.testRun: bounded liveness."""
+        p1 = Handel(make_params())
+        p1.init()
+        cont = RunMultipleTimes.cont_until_done()
+        while cont(p1) and p1.network().time < 20000:
+            p1.network().run_ms(1000)
+        assert not cont(p1)
+
+    def test_levels_structure(self):
+        p = Handel(make_params(node_count=32, threshold=30, nodes_down=0))
+        p.init()
+        n0 = p.network().get_node_by_id(0)
+        # 32 nodes -> levels 0..5; level l waits for 2^(l-1) sigs
+        assert len(n0.levels) == 6
+        assert [l.expected_sigs() for l in n0.levels] == [1, 1, 2, 4, 8, 16]
+        # emission list covers every expected node exactly once
+        for l in n0.levels[1:]:
+            assert sorted(pp.node_id for pp in l.peers) == [
+                i for i in range(32) if (l.waited_sigs >> i) & 1
+            ]
+
+    def test_byzantine_suicide_run(self):
+        p = Handel(
+            make_params(
+                node_count=64,
+                threshold=48,
+                nodes_down=16,
+                desynchronized_start=0,
+                byzantine_suicide=True,
+            )
+        )
+        p.init()
+        cont = RunMultipleTimes.cont_until_done()
+        while cont(p) and p.network().time < 30000:
+            p.network().run_ms(1000)
+        assert not cont(p)
+        # at least one node must have blacklisted a byzantine peer
+        assert any(n.blacklist for n in p.network().live_nodes())
+
+    def test_hidden_byzantine_run(self):
+        p = Handel(
+            make_params(
+                node_count=64,
+                threshold=48,
+                nodes_down=16,
+                desynchronized_start=0,
+                hidden_byzantine=True,
+            )
+        )
+        p.init()
+        cont = RunMultipleTimes.cont_until_done()
+        while cont(p) and p.network().time < 30000:
+            p.network().run_ms(1000)
+        assert not cont(p)
+
+    def test_window_adaptation(self):
+        p = make_params()
+        assert p.window_new_size(16, True) == 32
+        assert p.window_new_size(16, False) == 4
+        assert p.window_new_size(128, True) == 128  # max clamp
+        assert p.window_new_size(1, False) == 1  # min clamp

@@ -74,12 +74,14 @@ class BatchedP2PFlood(BatchedProtocol):
         dest = self.adj[src].reshape(-1)
         excl_r = jnp.repeat(exclude, n_peers)
         ok = mask_r & (dest >= 0) & (dest != excl_r)
-        # sendPeers/_send_multi spacing: k-th destination leaves at
-        # base + k*(delay+1) when delay_between_sends > 0 (Network.java:449-467)
+        # sendPeers/_send_multi spacing: k-th *actual* destination leaves at
+        # base + k*(delay+1) when delay_between_sends > 0 (Network.java:
+        # 449-467) — rank over the compacted send list, so an excluded
+        # sender mid-list leaves no spacing gap
         base = state.time + 1 + p.delay_before_resent
-        rank = jnp.tile(jnp.arange(n_peers, dtype=jnp.int32), (k,))
+        rank = (jnp.cumsum(ok.reshape(k, n_peers), axis=1) - 1).reshape(-1)
         spacing = (p.delay_between_sends + 1) if p.delay_between_sends > 0 else 0
-        send_time = jnp.broadcast_to(base, rank.shape) + rank * spacing
+        send_time = jnp.broadcast_to(base, rank.shape) + rank.astype(jnp.int32) * spacing
         return Emission(
             mask=ok,
             from_idx=src_r,

@@ -38,15 +38,17 @@ class StatSeries:
 
 
 def stat_series(title: str, series: List[Series]) -> StatSeries:
-    """Per-index min/max/avg across series; indexes must share x values
-    (Graph.java:214-250); shorter series simply stop contributing."""
+    """Per-index min/max/avg across series; indexes must share x values.
+    Exhausted (shorter) series carry their last value into the average but
+    not min/max, and the divisor is the full series count — exactly
+    Graph.statSeries (Graph.java:214-250)."""
     s_min = Series(f"{title}(min)")
     s_max = Series(f"{title}(max)")
     s_avg = Series(f"{title}(avg)")
     largest = max(series, key=lambda s: len(s.vals), default=None)
     for i in range(len(largest.vals) if largest else 0):
         x = largest.vals[i].x
-        tot, cnt = 0.0, 0
+        tot = 0.0
         mn, mx = float("inf"), float("-inf")
         for s in series:
             if i < len(s.vals):
@@ -56,12 +58,13 @@ def stat_series(title: str, series: List[Series]) -> StatSeries:
                     )
                 y = s.vals[i].y
                 tot += y
-                cnt += 1
                 mn = min(mn, y)
                 mx = max(mx, y)
+            else:
+                tot += s.vals[-1].y
         s_min.add_line(ReportLine(x, mn))
         s_max.add_line(ReportLine(x, mx))
-        s_avg.add_line(ReportLine(x, tot / cnt))
+        s_avg.add_line(ReportLine(x, tot / len(series)))
     return StatSeries(s_min, s_max, s_avg)
 
 

@@ -18,7 +18,17 @@ def include(big: int, small: int) -> bool:
 
 
 def cardinality(bits: int) -> int:
-    return bin(bits).count("1")
+    return bits.bit_count()
+
+
+def to_ids(bits: int) -> list:
+    """Ascending indices of the set bits (BitSet.nextSetBit iteration)."""
+    res = []
+    while bits:
+        lsb = bits & -bits
+        res.append(lsb.bit_length() - 1)
+        bits ^= lsb
+    return res
 
 
 def int_to_packed(bits: int, n_words: int) -> np.ndarray:
