@@ -1,67 +1,92 @@
-"""Benchmark: batched-engine simulation throughput vs the oracle DES.
+"""Benchmark: batched Handel aggregation throughput vs the oracle DES.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Current flagship config: PingPong 1000 nodes, NetworkLatencyByDistanceWJitter,
-700 simulated ms (full convergence — BASELINE.md README progression).  The
-baseline is the single-threaded oracle DES running the identical simulation
-on the host, which is this rebuild's stand-in for the reference Java loop
-(same algorithm, same event semantics).  vs_baseline = batched sims/sec
-divided by oracle sims/sec, i.e. the TPU speedup factor."""
+Flagship config per BASELINE.json: Handel BLS aggregation, 4096 nodes
+(0% Byzantine for the headline number), NetworkLatencyByDistanceWJitter.
+One "sim" = 1000 simulated ms of the full protocol — all nodes reach the
+99% threshold well within that horizon.  The baseline is the single-thread
+oracle DES (this repo's exact-semantics port of the reference's Java event
+loop) running the identical configuration once; vs_baseline is the
+speedup: batched sims/sec divided by oracle sims/sec.
+
+On non-TPU hosts (CPU smoke runs) the node count and replica count shrink
+so the bench stays fast; the driver's TPU run uses the full 4096."""
 
 from __future__ import annotations
 
 import json
 import time
 
+SIM_MS = 1000
+
 
 def _ensure_backend() -> None:
     """If the pinned platform can't initialize (e.g. the TPU tunnel is
-    down), fall back to CPU at the jax-config level — the env var alone is
-    overridden by the environment's sitecustomize (see tests/conftest.py)."""
+    down), fall back to CPU at the jax-config level.  A dead tunnel makes
+    jax.devices() HANG rather than raise (see tests/conftest.py), so the
+    probe runs in a subprocess with a timeout — the parent only touches
+    jax after the verdict."""
+    import subprocess
+    import sys
+
     import jax
 
     try:
-        jax.devices()
-    except RuntimeError:
+        ok = (
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=90,
+                capture_output=True,
+            ).returncode
+            == 0
+        )
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
         jax.config.update("jax_platforms", "cpu")
-        jax.devices()
-
-SIM_MS = 700
-NODE_CT = 1000
+    jax.devices()
 
 
-def bench_oracle(runs: int = 3) -> float:
-    from wittgenstein_tpu.protocols.pingpong import PingPong, PingPongParameters
+def _params(node_ct: int):
+    from wittgenstein_tpu.protocols.handel import HandelParameters
 
-    # time only run_ms, like the batched side (construction/init amortize)
-    elapsed = 0.0
-    for seed in range(runs):
-        p = PingPong(PingPongParameters(node_ct=NODE_CT))
-        p.network().rd.set_seed(seed)
-        p.init()
-        t0 = time.perf_counter()
-        p.network().run_ms(SIM_MS)
-        elapsed += time.perf_counter() - t0
-        assert p.network().get_node_by_id(0).pong == NODE_CT
-    return runs / elapsed
+    return HandelParameters(
+        node_count=node_ct,
+        threshold=int(node_ct * 0.99),
+        pairing_time=3,
+        level_wait_time=50,
+        extra_cycle=10,
+        dissemination_period_ms=10,
+        fast_path=10,
+        nodes_down=0,
+    )
 
 
-def bench_batched() -> float:
+def bench_oracle(node_ct: int) -> float:
+    from wittgenstein_tpu.protocols.handel import Handel
+
+    p = Handel(_params(node_ct))
+    p.init()
+    t0 = time.perf_counter()
+    p.network().run_ms(SIM_MS)
+    dt = time.perf_counter() - t0
+    assert all(n.done_at > 0 for n in p.network().live_nodes()), "oracle not done"
+    return 1.0 / dt
+
+
+def bench_batched(node_ct: int, n_replicas: int) -> float:
     import jax
 
     from wittgenstein_tpu.engine import replicate_state
-    from wittgenstein_tpu.protocols.pingpong_batched import make_pingpong
+    from wittgenstein_tpu.protocols.handel_batched import make_handel
 
-    platform = jax.devices()[0].platform
-    n_replicas = 256 if platform == "tpu" else 16
-
-    net, state = make_pingpong(NODE_CT)
+    net, state = make_handel(_params(node_ct))
     states = replicate_state(state, n_replicas)
     run = jax.jit(lambda s: net.run_ms_batched(s, SIM_MS))
     out = run(states)  # compile + warmup
     jax.block_until_ready(out)
-    assert int(out.proto["pong"][:, 0].min()) == NODE_CT, "sim did not converge"
+    assert int(out.done_at.min()) > 0, "sim did not converge"
     assert int(out.dropped.max()) == 0, "message ring overflow"
 
     t0 = time.perf_counter()
@@ -73,12 +98,20 @@ def bench_batched() -> float:
 
 def main() -> None:
     _ensure_backend()
-    batched = bench_batched()
-    oracle = bench_oracle()
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform == "tpu":
+        node_ct, n_replicas = 4096, 32
+    else:
+        node_ct, n_replicas = 256, 4
+
+    batched = bench_batched(node_ct, n_replicas)
+    oracle = bench_oracle(node_ct)
     print(
         json.dumps(
             {
-                "metric": f"pingpong{NODE_CT}_sims_per_sec_chip",
+                "metric": f"handel{node_ct}_sims_per_sec_chip",
                 "value": round(batched, 3),
                 "unit": "sims/sec",
                 "vs_baseline": round(batched / oracle, 3),

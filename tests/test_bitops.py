@@ -1,0 +1,64 @@
+"""Packed-bitset kernels vs plain python-int bitsets."""
+
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.ops.bitops import (
+    level_block_mask,
+    popcount_words,
+    xor_shuffle,
+)
+from wittgenstein_tpu.utils.bitset import int_to_packed, packed_to_int
+
+
+def ref_xor_shuffle(bits: int, v: int, n: int) -> int:
+    out = 0
+    for j in range(n):
+        if (bits >> j) & 1:
+            out |= 1 << (j ^ v)
+    return out
+
+
+class TestXorShuffle:
+    @pytest.mark.parametrize("v", [0, 1, 5, 31, 32, 37, 63, 100, 255])
+    def test_matches_reference(self, v):
+        rng = np.random.default_rng(42)
+        n = 256
+        bits = int.from_bytes(rng.bytes(n // 8), "little")
+        packed = int_to_packed(bits, n // 32)
+        out = np.asarray(xor_shuffle(packed, v))
+        assert packed_to_int(out) == ref_xor_shuffle(bits, v, n)
+
+    def test_involution(self):
+        rng = np.random.default_rng(0)
+        packed = rng.integers(0, 2**32, size=8, dtype=np.uint32)
+        out = np.asarray(xor_shuffle(xor_shuffle(packed, 77), 77))
+        assert (out == packed).all()
+
+    def test_batched_v(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(1)
+        words = rng.integers(0, 2**32, size=(4, 8), dtype=np.uint32)
+        vs = np.array([0, 3, 64, 99], dtype=np.int32)
+        out = np.asarray(xor_shuffle(jnp.asarray(words), jnp.asarray(vs)))
+        for i in range(4):
+            expect = ref_xor_shuffle(packed_to_int(words[i]), int(vs[i]), 256)
+            assert packed_to_int(out[i]) == expect
+
+
+class TestMasksAndCounts:
+    def test_popcount(self):
+        words = np.array([[0xFFFFFFFF, 0x1], [0x0, 0x80000000]], dtype=np.uint32)
+        assert list(np.asarray(popcount_words(words))) == [33, 1]
+
+    def test_level_block_mask(self):
+        n_words = 4  # 128 bits
+        assert packed_to_int(level_block_mask(0, n_words)) == 0b1
+        assert packed_to_int(level_block_mask(1, n_words)) == 0b10
+        assert packed_to_int(level_block_mask(2, n_words)) == 0b1100
+        m3 = packed_to_int(level_block_mask(3, n_words))
+        assert m3 == ((1 << 8) - 1) ^ ((1 << 4) - 1)
+        # level 7: bits [64, 128) spans words 2-3
+        m7 = packed_to_int(level_block_mask(7, n_words))
+        assert m7 == ((1 << 128) - 1) ^ ((1 << 64) - 1)

@@ -86,15 +86,16 @@ class Emission:
     Network.send call, Network.java:341-447).
 
     mask[K] selects real sends; from_idx/to_idx[K] are node ids; payload is
-    [K, P] (or None when P=0).  arrival, when given, bypasses the latency
-    model AND sender counters (the analog of sendArriveAt,
-    Network.java:419-422, used for task-style self-messages); declare such
-    types with msg_size 0 so receiver counters skip them too."""
+    [K, P] (or None when P=0).  mtype may be a static int or a per-row
+    [K] array (protocols with per-level message types).  arrival, when
+    given, bypasses the latency model AND sender counters (the analog of
+    sendArriveAt, Network.java:419-422, used for task-style self-messages);
+    declare such types with msg_size 0 so receiver counters skip them too."""
 
     mask: jnp.ndarray
     from_idx: jnp.ndarray
     to_idx: jnp.ndarray
-    mtype: int
+    mtype: "int | jnp.ndarray"
     payload: Optional[jnp.ndarray] = None
     send_time: Optional[jnp.ndarray] = None  # default: state.time + 1
     arrival: Optional[jnp.ndarray] = None  # explicit arrival times [K]
@@ -173,6 +174,49 @@ class BatchedNetwork:
         ).astype(jnp.int32)
 
     # -- the send path (createMessageArrival, Network.java:469-487) ----------
+    def latency_arrivals(self, state, mask, from_idx, to_idx, send_time, mtype):
+        """The createMessageArrival kernel shared by the generic ring and
+        protocol-specific message channels: ticks sender counters (even for
+        dropped sends, Network.java:476-477), samples the latency model via
+        the counter RNG, applies partition/down/discard filters.  Returns
+        (state, ok, arrival)."""
+        k = mask.shape[0]
+        from_idx = from_idx.astype(jnp.int32)
+        to_idx = to_idx.astype(jnp.int32)
+        mtype = jnp.asarray(mtype, jnp.int32)  # scalar or per-row [K]
+        size = jnp.asarray(self._msg_sizes, jnp.int32)[mtype]
+        state = state._replace(
+            msg_sent=state.msg_sent.at[from_idx].add(mask.astype(jnp.int32)),
+            bytes_sent=state.bytes_sent.at[from_idx].add(
+                mask.astype(jnp.int32) * size
+            ),
+            send_ctr=state.send_ctr + 1,
+        )
+        # per-event seed: the batched analog of rd.nextInt() per send;
+        # send_ctr + row index decorrelate same-tick same-type sends
+        seed = hash32(
+            state.seed,
+            send_time,
+            from_idx,
+            mtype,
+            state.send_ctr,
+            jnp.arange(k, dtype=jnp.int32),
+        )
+        delta = pseudo_delta(to_idx, seed)
+        static = LatencyStatic(state.x, state.y, state.extra_latency, state.city_idx)
+        lat = vec_latency(self.latency, static, from_idx, to_idx, delta)
+        arrival = jnp.asarray(send_time, jnp.int32) + lat
+        pid_f = self.partition_id(state, state.x[from_idx])
+        pid_t = self.partition_id(state, state.x[to_idx])
+        ok = (
+            mask
+            & ~state.down[from_idx]
+            & ~state.down[to_idx]
+            & (pid_f == pid_t)
+            & (lat < self.msg_discard_time)
+        )
+        return state, ok, arrival
+
     def apply_emission(self, state: SimState, em: Emission) -> SimState:
         k = em.mask.shape[0]
         send_time = em.send_time if em.send_time is not None else state.time + 1
@@ -180,6 +224,7 @@ class BatchedNetwork:
         from_idx = em.from_idx.astype(jnp.int32)
         to_idx = em.to_idx.astype(jnp.int32)
 
+        mtype = jnp.asarray(em.mtype, jnp.int32)  # scalar or per-row [K]
         if em.arrival is not None:
             # sendArriveAt path: explicit arrival, no latency model and no
             # sender counters (Network.sendArriveAt, Network.java:419-422,
@@ -187,37 +232,8 @@ class BatchedNetwork:
             arrival = em.arrival.astype(jnp.int32)
             ok = mask
         else:
-            # sender counters tick even for dropped/partitioned messages
-            # (Network.java:476-477 increments before the partition check)
-            size = jnp.int32(self._msg_sizes[em.mtype])
-            state = state._replace(
-                msg_sent=state.msg_sent.at[from_idx].add(mask.astype(jnp.int32)),
-                bytes_sent=state.bytes_sent.at[from_idx].add(
-                    mask.astype(jnp.int32) * size
-                ),
-            )
-            # per-event seed: the batched analog of rd.nextInt() per send;
-            # send_ctr + row index decorrelate same-tick same-type sends
-            seed = hash32(
-                state.seed,
-                send_time,
-                from_idx,
-                jnp.int32(em.mtype),
-                state.send_ctr,
-                jnp.arange(k, dtype=jnp.int32),
-            )
-            delta = pseudo_delta(to_idx, seed)
-            static = LatencyStatic(state.x, state.y, state.extra_latency, state.city_idx)
-            lat = vec_latency(self.latency, static, from_idx, to_idx, delta)
-            arrival = send_time + lat
-            pid_f = self.partition_id(state, state.x[from_idx])
-            pid_t = self.partition_id(state, state.x[to_idx])
-            ok = (
-                mask
-                & ~state.down[from_idx]
-                & ~state.down[to_idx]
-                & (pid_f == pid_t)
-                & (lat < self.msg_discard_time)
+            state, ok, arrival = self.latency_arrivals(
+                state, mask, from_idx, to_idx, send_time, mtype
             )
 
         # pack the ok-messages into ring slots [head, head+n_ok) (mod C)
@@ -243,10 +259,11 @@ class BatchedNetwork:
             msg_arrival=state.msg_arrival.at[pos].set(arrival, mode="drop"),
             msg_from=state.msg_from.at[pos].set(from_idx, mode="drop"),
             msg_to=state.msg_to.at[pos].set(to_idx, mode="drop"),
-            msg_type=state.msg_type.at[pos].set(jnp.int32(em.mtype), mode="drop"),
+            msg_type=state.msg_type.at[pos].set(
+                jnp.broadcast_to(mtype, (k,)), mode="drop"
+            ),
             msg_head=lax.rem(state.msg_head + n_ok, jnp.int32(self.capacity)),
             dropped=state.dropped + overwritten,
-            send_ctr=state.send_ctr + 1,
         )
         if self.payload_width:
             new = new._replace(

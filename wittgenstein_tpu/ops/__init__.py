@@ -1,0 +1,10 @@
+"""Vectorized bit-level ops for the batched engine (packed uint32 bitsets)."""
+
+from .bitops import (
+    block_mask,
+    level_block_mask,
+    popcount_words,
+    xor_shuffle,
+)
+
+__all__ = ["block_mask", "level_block_mask", "popcount_words", "xor_shuffle"]

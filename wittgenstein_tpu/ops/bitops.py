@@ -1,0 +1,82 @@
+"""Packed-bitset kernels for aggregation protocols.
+
+The batched Handel/GSF state keeps per-node contribution bitsets in an
+XOR-relative layout: bit j of node i's vector refers to node (i ^ j).
+Under that layout the binary-split level structure (Handel.allSigsAtLevel,
+Handel.java:634-647) becomes uniform across nodes — level l occupies bit
+block [2^(l-1), 2^l) for every node — and re-addressing a contribution
+from sender s's space into receiver r's space is the bit permutation
+j -> j ^ (r ^ s), implemented below as a word gather (high bits) plus a
+5-stage butterfly (low bits).  All ops are jnp-traceable and vmap over
+leading axes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+WORD = 32
+_BUTTERFLY_MASKS = np.array(
+    [0x55555555, 0x33333333, 0x0F0F0F0F, 0x00FF00FF, 0x0000FFFF], dtype=np.uint32
+)
+
+
+def popcount_words(words) -> jnp.ndarray:
+    """Total set bits over the last axis of packed uint32 words."""
+    return jnp.sum(
+        lax.population_count(words.astype(jnp.uint32)).astype(jnp.int32), axis=-1
+    )
+
+
+def xor_shuffle(words, v):
+    """Permute bit positions j -> j ^ v of packed vectors.
+
+    words: [..., W] uint32; v: int32 scalar or [...] batch of xor values
+    (dynamic).  Word-level part uses a gather on index ^ (v >> 5); bit-level
+    part applies 5 conditional butterfly stages for v & 31.
+    """
+    words = words.astype(jnp.uint32)
+    w = words.shape[-1]
+    v = jnp.asarray(v, jnp.int32)
+    v_hi = lax.shift_right_logical(v, 5)
+    v_lo = v & 31
+
+    idx = jnp.arange(w, dtype=jnp.int32)
+    # broadcast v over the leading axes: gather words[..., idx ^ v_hi]
+    gathered = jnp.take_along_axis(
+        words,
+        jnp.broadcast_to(
+            idx ^ v_hi[..., None] if v.ndim else idx ^ v_hi,
+            words.shape,
+        ),
+        axis=-1,
+    )
+
+    x = gathered
+    for b in range(5):
+        m = jnp.uint32(_BUTTERFLY_MASKS[b])
+        sh = jnp.uint32(1 << b)
+        swapped = ((x & m) << sh) | (lax.shift_right_logical(x, sh) & m)
+        bit = lax.shift_right_logical(v_lo, b) & 1
+        cond = (bit == 1) if v.ndim == 0 else (bit == 1)[..., None]
+        x = jnp.where(cond, swapped, x)
+    return x
+
+
+def block_mask(start: int, end: int, n_words: int) -> np.ndarray:
+    """Static mask with bits [start, end) set, as packed uint32 words."""
+    bits = ((1 << end) - 1) ^ ((1 << start) - 1)
+    out = np.zeros(n_words, dtype=np.uint32)
+    for w in range(n_words):
+        out[w] = (bits >> (32 * w)) & 0xFFFFFFFF
+    return out
+
+
+def level_block_mask(level: int, n_words: int) -> np.ndarray:
+    """Mask of level `level`'s block in the XOR layout: bit 0 for level 0,
+    bits [2^(l-1), 2^l) for level l >= 1."""
+    if level == 0:
+        return block_mask(0, 1, n_words)
+    return block_mask(1 << (level - 1), 1 << level, n_words)

@@ -42,22 +42,9 @@ class GSFSignatureParameters(WParameters):
     network_latency_name: Optional[str] = None
 
     def __post_init__(self):
-        if self.threshold == -1:
-            self.threshold = int(self.node_count * 0.99)
-        elif isinstance(self.threshold, float):
-            # 1.0 means "everyone" only when used as a ratio of node_count
-            self.threshold = int(self.threshold * self.node_count)
-        if isinstance(self.nodes_down, float):
-            self.nodes_down = int(self.nodes_down * self.node_count)
-        if (
-            self.nodes_down >= self.node_count
-            or self.nodes_down < 0
-            or self.threshold > self.node_count
-            or (self.nodes_down + self.threshold > self.node_count)
-        ):
-            raise ValueError(
-                f"nodeCount={self.node_count}, threshold={self.threshold}"
-            )
+        from ._aggregation import normalize_agg_params
+
+        normalize_agg_params(self)
 
 
 class SendSigs(Message):
@@ -201,14 +188,9 @@ class GSFNode(Node):
 
     def all_sigs_at_level(self, round_: int) -> int:
         """Binary-tree membership trick (GSFSignature.java:361-374)."""
-        if round_ < 1:
-            raise ValueError(f"round={round_}")
-        c_mask = (1 << round_) - 1
-        start = (c_mask | self.node_id) ^ c_mask
-        end = min(self.node_id | c_mask, self.params.node_count - 1)
-        res = ((1 << (end + 1)) - 1) ^ ((1 << start) - 1)
-        res &= ~(1 << self.node_id)
-        return res
+        from ._aggregation import all_sigs_at_level
+
+        return all_sigs_at_level(self.node_id, round_, self.params.node_count)
 
     def update_verified_signatures(self, from_node: "GSFNode", level: int, holder: SendSigs) -> None:
         """Merge a verified signature set (GSFSignature.java:379-460).

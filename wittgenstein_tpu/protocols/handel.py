@@ -53,21 +53,9 @@ class HandelParameters(WParameters):
     window_decrease_factor: float = 4.0
 
     def __post_init__(self):
-        if self.threshold == -1:
-            self.threshold = int(self.node_count * 0.99)
-        elif isinstance(self.threshold, float):
-            self.threshold = int(self.threshold * self.node_count)
-        if isinstance(self.nodes_down, float):
-            self.nodes_down = int(self.nodes_down * self.node_count)
-        if (
-            self.nodes_down >= self.node_count
-            or self.nodes_down < 0
-            or self.threshold > self.node_count
-            or (self.nodes_down + self.threshold > self.node_count)
-        ):
-            raise ValueError(
-                f"nodeCount={self.node_count}, threshold={self.threshold}"
-            )
+        from ._aggregation import normalize_agg_params
+
+        normalize_agg_params(self)
         if self.node_count.bit_count() != 1:
             raise ValueError("We support only power of two nodes in this simulation")
         if self.byzantine_suicide and self.hidden_byzantine:
@@ -374,14 +362,10 @@ class HNode(Node):
         return max(0, _card(with_indiv) - _card(l.last_agg_verified))
 
     def all_sigs_at_level(self, round_: int) -> int:
-        if round_ < 1:
-            raise ValueError(f"round={round_}")
-        c_mask = (1 << round_) - 1
-        start = (c_mask | self.node_id) ^ c_mask
-        end = min(self.node_id | c_mask, self.params.node_count - 1)
-        res = ((1 << (end + 1)) - 1) ^ ((1 << start) - 1)
-        res &= ~(1 << self.node_id)
-        return res
+        """Binary-tree membership trick (Handel.java:634-647)."""
+        from ._aggregation import all_sigs_at_level
+
+        return all_sigs_at_level(self.node_id, round_, self.params.node_count)
 
     def update_verified_signatures(self, vs: SigToVerify) -> None:
         """Verification completion (:686-750)."""

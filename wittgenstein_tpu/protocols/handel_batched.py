@@ -1,0 +1,439 @@
+"""Batched Handel: the north-star protocol on the TPU engine.
+
+Re-expression of protocols/Handel.java for the batched time-stepped core.
+State is packed uint32 bitsets in the XOR-relative layout (ops.bitops):
+bit j of node i's vector is node i^j, so every node shares the same level
+geometry — level l = bit block [2^(l-1), 2^l) (Handel.allSigsAtLevel,
+Handel.java:634-647, becomes a static mask).
+
+Messaging uses a protocol-specific channel instead of the generic ring
+(SURVEY §7 "per-protocol message representations"): D in-flight slots per
+(receiver, level), slot = arrival mod D, each holding
+((arrival - now)<<REL_BITS | sender_rel, content) — time-RELATIVE keys,
+decremented once per tick, so the packing never overflows int32 no matter
+the simulation horizon.  Earliest arrival wins a slot;
+displaced sends are simply lost — Handel is a gossip protocol whose
+periodic dissemination re-offers content every period, which is exactly
+the redundancy the reference relies on for dropped/filtered messages.
+Delivery is then pure elementwise work on [N, L, D] arrays — no scatters
+on the delivery path, and memory is O(N·L·D·W) regardless of traffic.
+
+Mapping from the reference (semantics deltas are deliberate,
+distribution-parity approximations — each is noted):
+
+  * SendSigs content (totalOutgoing at the level = bits [0, 2^(l-1)) of
+    the sender's vector) is captured exactly at send time in the slot;
+  * the per-level toVerifyAgg queue becomes a one-candidate register
+    pend_key[N, L] + cand_sig[N, L, W/2], preferring fuller content (the
+    stand-in for bestToVerify's added-sigs scoring, Handel.java:566-630);
+  * checkSigs' uniformly-random choice among per-level bests
+    (Handel.java:788-790) is kept, via a counter-hash draw;
+  * verification completion follows updateVerifiedSignatures exactly:
+    verified individual bit, replace-on-intersect lastAgg, totalIncoming =
+    agg | ind, threshold -> doneAt (Handel.java:686-750);
+  * fastPath: on completing a level's incoming set, burst-send to
+    fast_path peers of the first higher level whose outgoing just
+    completed (Handel.java:738-742);
+  * extraCycle dissemination continuation after done; incoming is
+    filtered (msg_filtered) once done (Handel.java:752-756);
+  * emission order is a counter-hash offset + cycling cursor (stands in
+    for the reception-rank emission lists, Handel.java:991-1013).
+
+Byzantine attack modes are not yet ported to the batched path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.node import Node, build_node_columns
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..engine import BatchedNetwork, BatchedProtocol
+from ..engine.rng import hash32
+from ..ops.bitops import level_block_mask, popcount_words, xor_shuffle
+from ..utils.javarand import JavaRandom
+from .handel import HandelParameters
+
+INT32_MAX = np.int32(2**31 - 1)
+
+
+class BatchedHandel(BatchedProtocol):
+    TICK_INTERVAL = 1  # verification capacity is modeled per-ms
+    PAYLOAD_WIDTH = 0  # messaging bypasses the generic ring entirely
+    CHANNEL_DEPTH = 8  # in-flight slots per (receiver, level)
+
+    def __init__(self, params: HandelParameters):
+        self.params = params
+        n = params.node_count
+        if n & (n - 1):
+            raise ValueError("power-of-two node counts only")
+        self.n_nodes = n
+        self.n_words = max(1, n // 32)
+        self.n_levels = n.bit_length()  # levels 0..log2(n)
+        # outgoing content at any level fits in the low half of the vector
+        self.out_words = max(1, self.n_words // 2)
+        self.MSG_TYPES = [f"SIGS_L{l}" for l in range(self.n_levels)]
+        self.rel_bits = max(1, (n - 1).bit_length())
+        # static level masks
+        self.level_masks = np.stack(
+            [level_block_mask(l, self.n_words) for l in range(self.n_levels)]
+        )
+        low = np.zeros_like(self.level_masks)
+        acc = np.zeros(self.n_words, dtype=np.uint32)
+        for l in range(self.n_levels):
+            low[l] = acc  # bits below level l's block == outgoing content
+            acc = acc | self.level_masks[l]
+        self.low_masks = low
+
+    def msg_size(self, mtype: int) -> int:
+        # Size = level + bit field + the signatures included + our own sig
+        # (SendSigs, Handel.java:253-258)
+        expected = 1 if mtype == 0 else 1 << (mtype - 1)
+        return 1 + expected // 8 + 96 * 2
+
+    # -- state ---------------------------------------------------------------
+    def proto_init(self, n_nodes: int, pairing: np.ndarray, start_at: np.ndarray):
+        n, L = self.n_nodes, self.n_levels
+        own = np.zeros((n, self.n_words), dtype=np.uint32)
+        own[:, 0] = 1  # bit 0 = own signature (level 0)
+        return {
+            "agg": jnp.asarray(own),  # lastAggVerified per level block
+            "ind": jnp.asarray(own),  # verifiedIndSignatures
+            "inc": jnp.asarray(own),  # totalIncoming = agg | ind
+            # in-flight channel: D slots per (receiver, level)
+            "in_key": jnp.full((n, L, self.CHANNEL_DEPTH), INT32_MAX, jnp.int32),
+            "in_sig": jnp.zeros(
+                (n, L, self.CHANNEL_DEPTH, self.out_words), jnp.uint32
+            ),
+            # verification candidate per (receiver, level)
+            "pend_key": jnp.full((n, L), INT32_MAX, jnp.int32),
+            "cand_sig": jnp.zeros((n, L, self.out_words), jnp.uint32),
+            "busy_until": jnp.zeros(n, jnp.int32),
+            "pos": jnp.zeros((n, L), jnp.int32),
+            "added_cycle": jnp.full(n, self.params.extra_cycle, jnp.int32),
+            "sigs_checked": jnp.zeros(n, jnp.int32),
+            "msg_filtered": jnp.zeros(n, jnp.int32),
+            "pairing": jnp.asarray(pairing, jnp.int32),
+            "start_at": jnp.asarray(start_at, jnp.int32),
+        }
+
+    # -- helpers -------------------------------------------------------------
+    def _outgoing_complete(self, inc, level: int) -> jnp.ndarray:
+        want = 1 if level == 1 else 1 << (level - 1)
+        low = jnp.asarray(self.low_masks[level])
+        return popcount_words(inc & low) == want
+
+    def _incoming_complete(self, inc, level: int) -> jnp.ndarray:
+        want = 1 << (level - 1)
+        m = jnp.asarray(self.level_masks[level])
+        return popcount_words(inc & m) == want
+
+    def _send(self, net, state, mask, from_idx, to_idx, lv, content):
+        """Send K messages into the per-(receiver, level, arrival%D) slot;
+        earliest arrival wins a slot, ties broken by sender rel index."""
+        proto = state.proto
+        state, ok, arrival = net.latency_arrivals(
+            state, mask, from_idx, to_idx, state.time + 1, lv
+        )
+        rel = (to_idx ^ from_idx).astype(jnp.int32)
+        slot = lax.rem(arrival, jnp.int32(self.CHANNEL_DEPTH))
+        # time-relative arrival (>= 2): decremented per tick in
+        # _channel_deliver, so the key packing never overflows
+        rel_arr = arrival - state.time
+        key = jnp.where(ok, (rel_arr << self.rel_bits) | rel, INT32_MAX)
+        safe_to = jnp.where(ok, to_idx, self.n_nodes)
+        new_key = proto["in_key"].at[safe_to, lv, slot].min(key, mode="drop")
+        winner = ok & (new_key[to_idx, lv, slot] == key)
+        win_to = jnp.where(winner, to_idx, self.n_nodes)
+        new_sig = proto["in_sig"].at[win_to, lv, slot].set(
+            content.astype(jnp.uint32), mode="drop"
+        )
+        return state._replace(
+            proto=dict(proto, in_key=new_key, in_sig=new_sig)
+        )
+
+    # -- tick phases ---------------------------------------------------------
+    def _channel_deliver(self, net, state):
+        """Promote due in-flight slots into the verification candidate
+        register (onNewSig, Handel.java:752-786) — pure elementwise."""
+        proto = state.proto
+        t = state.time
+        # advance relative arrivals by one tick, then deliver the due ones
+        occupied = proto["in_key"] != INT32_MAX
+        in_key = jnp.where(
+            occupied, proto["in_key"] - (1 << self.rel_bits), proto["in_key"]
+        )  # [N, L, D]
+        due = occupied & ((in_key >> self.rel_bits) <= 0)
+        rel = in_key & ((1 << self.rel_bits) - 1)
+
+        # receiver traffic counters tick for every delivered message
+        # (Network.java:611-612, before onNewSig's own filters)
+        sizes = jnp.asarray(
+            [self.msg_size(l) for l in range(self.n_levels)], jnp.int32
+        )
+        dm = due.astype(jnp.int32)
+        state = state._replace(
+            msg_received=state.msg_received + jnp.sum(dm, axis=(1, 2)),
+            bytes_received=state.bytes_received
+            + jnp.sum(dm * sizes[None, :, None], axis=(1, 2)),
+        )
+
+        started = t >= proto["start_at"][:, None, None]
+        not_done = (state.done_at == 0)[:, None, None]
+        accept = due & started & not_done
+        filtered = jnp.sum((due & ~not_done).astype(jnp.int32), axis=(1, 2))
+
+        # candidate priority: fuller content first (the stand-in for the
+        # reference's added-sigs scoring), sender rel as tie-break
+        content_bits = popcount_words(proto["in_sig"]).astype(jnp.int32)  # [N, L, D]
+        half = self.n_nodes // 2
+        prio = half + 1 - jnp.minimum(content_bits, half)
+        key2 = jnp.where(accept, (prio << self.rel_bits) | rel, INT32_MAX)
+        # best due slot per (receiver, level), then fold into the register
+        best_d = jnp.argmin(key2, axis=2)  # [N, L]
+        best_key = jnp.take_along_axis(key2, best_d[:, :, None], axis=2)[:, :, 0]
+        best_sig = jnp.take_along_axis(
+            proto["in_sig"], best_d[:, :, None, None], axis=2
+        )[:, :, 0, :]
+        better = best_key < proto["pend_key"]
+
+        state = state._replace(
+            proto=dict(
+                proto,
+                in_key=jnp.where(due, INT32_MAX, in_key),
+                pend_key=jnp.where(better, best_key, proto["pend_key"]),
+                cand_sig=jnp.where(better[..., None], best_sig, proto["cand_sig"]),
+                msg_filtered=proto["msg_filtered"] + filtered,
+            )
+        )
+        return state
+
+    def _dissemination(self, net, state):
+        """Periodic doCycle over open levels (Handel.java:331-343, 452-480)."""
+        p = self.params
+        proto = state.proto
+        t = state.time
+        ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
+
+        start = proto["start_at"] + 1
+        on_beat = (t >= start) & (
+            lax.rem(t - start, jnp.int32(p.dissemination_period_ms)) == 0
+        )
+        is_done = state.done_at > 0
+        may_send = on_beat & ~state.down & (~is_done | (proto["added_cycle"] > 0))
+        new_added = jnp.where(
+            on_beat & is_done & (proto["added_cycle"] > 0),
+            proto["added_cycle"] - 1,
+            proto["added_cycle"],
+        )
+
+        masks, dests, types, contents = [], [], [], []
+        new_pos = proto["pos"]
+        for l in range(1, self.n_levels):
+            bs = 1 << (l - 1)
+            opened = t >= (l - 1) * p.level_wait_time
+            complete = self._outgoing_complete(proto["inc"], l)
+            mask = may_send & (opened | complete)
+            offset = hash32(state.seed, ids, jnp.int32(l)) & (bs - 1)
+            rel = (bs + ((new_pos[:, l] + offset) & (bs - 1))).astype(jnp.int32)
+            new_pos = new_pos.at[:, l].set(
+                jnp.where(mask, new_pos[:, l] + 1, new_pos[:, l])
+            )
+            masks.append(mask)
+            dests.append(ids ^ rel)
+            types.append(jnp.full(self.n_nodes, l, jnp.int32))
+            contents.append(
+                (proto["inc"] & jnp.asarray(self.low_masks[l]))[:, : self.out_words]
+            )
+        state = state._replace(proto=dict(proto, pos=new_pos, added_cycle=new_added))
+        state = self._send(
+            net,
+            state,
+            jnp.concatenate(masks),
+            jnp.tile(ids, self.n_levels - 1),
+            jnp.concatenate(dests),
+            jnp.concatenate(types),
+            jnp.concatenate(contents, axis=0),
+        )
+        return state
+
+    def _verify(self, net, state):
+        """checkSigs + updateVerifiedSignatures, one verification per free
+        node per tick (capacity = pairingTime serialization)."""
+        p = self.params
+        proto = state.proto
+        t = state.time
+        n, L = self.n_nodes, self.n_levels
+        ids = jnp.arange(n, dtype=jnp.int32)
+
+        keys = proto["pend_key"]  # [N, L]
+        valid = keys < INT32_MAX
+        can = (
+            (proto["busy_until"] <= t)
+            & ~state.down
+            & (t >= proto["start_at"] + 1)
+            & jnp.any(valid, axis=1)
+        )
+
+        # chooseBestFromLevels: uniform random among levels with candidates
+        rnd = (hash32(state.seed, t, ids, jnp.int32(0x5EED)).astype(jnp.uint32)
+               >> jnp.uint32(8)).astype(jnp.int32)
+        vcount = jnp.sum(valid, axis=1).astype(jnp.int32)
+        pick = jnp.where(vcount > 0, lax.rem(rnd, jnp.maximum(vcount, 1)), 0)
+        cum = jnp.cumsum(valid, axis=1)
+        level_sel = jnp.argmax((cum == (pick + 1)[:, None]) & valid, axis=1)
+
+        key_sel = jnp.take_along_axis(keys, level_sel[:, None], axis=1)[:, 0]
+        rel = jnp.where(can, key_sel & ((1 << self.rel_bits) - 1), 0)
+
+        # the candidate's exact send-time content, re-addressed into our
+        # space by the xor permutation
+        cand = jnp.take_along_axis(
+            proto["cand_sig"], level_sel[:, None, None], axis=1
+        )[:, 0, :]
+        pad = jnp.zeros((n, self.n_words - self.out_words), jnp.uint32)
+        sig = xor_shuffle(jnp.concatenate([cand, pad], axis=1), rel)
+        lmask = jnp.asarray(self.level_masks)[level_sel]
+        sig = sig & lmask  # safety: stay within the level block
+
+        canw = can[:, None]
+        agg, ind, inc = proto["agg"], proto["ind"], proto["inc"]
+
+        # verifiedIndSignatures.set(from) — the sender bit
+        one = np.zeros(self.n_words, dtype=np.uint32)
+        one[0] = 1
+        ind_bit = xor_shuffle(jnp.broadcast_to(jnp.asarray(one), (n, self.n_words)), rel)
+        new_ind = jnp.where(canw, ind | ind_bit, ind)
+
+        # lastAgg replace-on-intersect (Handel.java:714-722)
+        agg_l = agg & lmask
+        intersects = popcount_words(agg_l & sig) > 0
+        new_agg_l = jnp.where(intersects[:, None], sig, agg_l | sig)
+        new_agg = jnp.where(canw, (agg & ~lmask) | new_agg_l, agg)
+        new_inc = jnp.where(canw, (new_agg | new_ind), inc)
+
+        was_complete = jnp.stack(
+            [self._incoming_complete(inc, l) for l in range(1, L)], axis=1
+        )
+        now_complete = jnp.stack(
+            [self._incoming_complete(new_inc, l) for l in range(1, L)], axis=1
+        )
+
+        new_keys = jnp.where(
+            can[:, None] & (jnp.arange(L)[None, :] == level_sel[:, None]),
+            INT32_MAX,
+            keys,
+        )
+        new_busy = jnp.where(can, t + proto["pairing"], proto["busy_until"])
+        checked = proto["sigs_checked"] + can.astype(jnp.int32)
+
+        total = popcount_words(new_inc)
+        done_now = (state.done_at == 0) & ~state.down & (total >= p.threshold)
+        new_done_at = jnp.where(done_now, t, state.done_at)
+
+        state = state._replace(
+            done_at=new_done_at,
+            proto=dict(
+                proto,
+                agg=new_agg,
+                ind=new_ind,
+                inc=new_inc,
+                pend_key=new_keys,
+                busy_until=new_busy,
+                sigs_checked=checked,
+            ),
+        )
+
+        # fastPath burst: a just-completed incoming level completes the
+        # outgoing of the next level -> contact fast_path peers of the first
+        # higher level that is still incomplete (Handel.java:738-742)
+        just = can & jnp.any(now_complete & ~was_complete, axis=1)
+        if p.fast_path > 0:
+            out_done = jnp.stack(
+                [self._outgoing_complete(new_inc, l) for l in range(1, L)], axis=1
+            )
+            target_ok = out_done & ~now_complete
+            has_target = jnp.any(target_ok, axis=1)
+            lsel = (jnp.argmax(target_ok, axis=1) + 1).astype(jnp.int32)
+            bs = (1 << (lsel - 1)).astype(jnp.int32)
+            fp_mask = just & has_target
+            fp = min(p.fast_path, max(1, self.n_nodes // 2))
+            offset = hash32(state.seed, ids, lsel, t)
+            ks = jnp.arange(fp, dtype=jnp.int32)
+            rel_fp = (
+                bs[:, None] + ((offset[:, None] + ks[None, :]) & (bs[:, None] - 1))
+            ).astype(jnp.int32)
+            mask_fp = fp_mask[:, None] & (ks[None, :] < bs[:, None])
+            low_sel = jnp.asarray(self.low_masks)[lsel]
+            content = (new_inc & low_sel)[:, : self.out_words]
+            state = self._send(
+                net,
+                state,
+                mask_fp.reshape(-1),
+                jnp.repeat(ids, fp),
+                (ids[:, None] ^ rel_fp).reshape(-1),
+                jnp.repeat(lsel, fp),
+                jnp.repeat(content, fp, axis=0),
+            )
+        return state
+
+    # -- engine hooks --------------------------------------------------------
+    def tick(self, net, state):
+        state = self._channel_deliver(net, state)
+        state = self._dissemination(net, state)
+        state = self._verify(net, state)
+        return state
+
+    def all_done(self, state):
+        live = ~state.down
+        return jnp.all(jnp.where(live, state.done_at > 0, True))
+
+
+def make_handel(
+    params: Optional[HandelParameters] = None,
+    capacity: int = 8,  # generic ring unused by this protocol
+    seed: int = 0,
+):
+    """Host-side construction: build the node population with the oracle's
+    RNG stream (positions, speed ratios, down set), bake into the engine."""
+    params = params or HandelParameters()
+    n = params.node_count
+    nb = registry_node_builders.get_by_name(params.node_builder_name)
+    latency = registry_network_latencies.get_by_name(params.network_latency_name)
+    rd = JavaRandom(0)
+
+    from ..oracle.network import Network as ONetwork
+
+    if params.bad_nodes is not None:
+        bad_bits = params.bad_nodes
+        bad = {i for i in range(n) if (bad_bits >> i) & 1}
+    else:
+        bad = ONetwork.choose_bad_nodes(rd, n, params.nodes_down)
+
+    nodes = []
+    start_at = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        if params.desynchronized_start != 0:
+            start_at[i] = rd.next_int(params.desynchronized_start)
+        nodes.append(Node(rd, nb))
+    down = np.array([i in bad for i in range(n)])
+
+    pairing = np.maximum(
+        1, (params.pairing_time * np.array([nd.speed_ratio for nd in nodes]))
+    ).astype(np.int32)
+
+    city_index = getattr(latency, "city_index", None)
+    cols = build_node_columns(nodes, city_index)
+    proto = BatchedHandel(params)
+    net = BatchedNetwork(proto, latency, n, capacity=capacity)
+    state = net.init_state(
+        cols,
+        seed=seed,
+        proto=proto.proto_init(n, pairing, start_at),
+        down=down,
+    )
+    return net, state
