@@ -1,0 +1,77 @@
+"""Paxos + OptimisticP2PSignature tests (ported from PaxosTest.java and
+OptimisticP2PSignatureTest.java)."""
+
+from wittgenstein_tpu.core.registries import builder_name, RANDOM
+from wittgenstein_tpu.protocols.optimistic_p2p_signature import (
+    OptimisticP2PSignature,
+    OptimisticP2PSignatureParameters,
+)
+from wittgenstein_tpu.protocols.paxos import Paxos, PaxosParameters, ProposerNode
+
+NB = builder_name(RANDOM, True, 0)
+NL = "NetworkLatencyByDistanceWJitter"
+
+
+class TestPaxos:
+    def test_simple(self):
+        p = Paxos(PaxosParameters(3, 1, 1000, None, None))
+        p.init()
+        p.network().run(10)
+        assert len(p.network().all_nodes) == 4
+        assert p.majority == 2
+        for n in p.proposers:
+            assert n.seq_ip > 0
+
+    def test_copy(self):
+        p1 = Paxos(PaxosParameters(3, 2, 1000, None, None))
+        p2 = p1.copy()
+        p1.init()
+        p1.network().run_ms(2000)
+        p2.init()
+        p2.network().run_ms(2000)
+        for n1 in p1.network().all_nodes:
+            n2 = p2.network().get_node_by_id(n1.node_id)
+            assert n2 is not None
+            assert n1.msg_received == n2.msg_received
+
+    def test_play(self):
+        Paxos(PaxosParameters()).play()
+
+    def test_agreement(self):
+        """All proposers that finished accepted the same value."""
+        p = Paxos(PaxosParameters(5, 3, 1000, None, None))
+        p.init()
+        p.network().run(20)
+        vals = {pn.value_accepted for pn in p.proposers if pn.value_accepted is not None}
+        assert len(vals) == 1
+
+
+class TestOptimisticP2PSignature:
+    def test_simple(self):
+        n_ct = 100
+        p = OptimisticP2PSignature(
+            OptimisticP2PSignatureParameters(n_ct, n_ct // 2 + 1, 13, 3, NB, NL)
+        )
+        p.init()
+        p.network().run(10)
+        assert len(p.network().all_nodes) == n_ct
+        for n in p.network().all_nodes:
+            assert not n.is_down()
+            assert n.done_at > 0
+            assert n.done
+            assert n.verified_signatures.bit_count() > n_ct // 2
+
+    def test_copy(self):
+        p1 = OptimisticP2PSignature(
+            OptimisticP2PSignatureParameters(200, 160, 10, 2, NB, NL)
+        )
+        p2 = p1.copy()
+        p1.init()
+        p1.network().run_ms(200)
+        p2.init()
+        p2.network().run_ms(200)
+        for n1 in p1.network().all_nodes:
+            n2 = p2.network().get_node_by_id(n1.node_id)
+            assert n2 is not None
+            assert n1.done == n2.done
+            assert n1.done_at == n2.done_at

@@ -1,0 +1,71 @@
+"""Slush + Snowflake: convergence, copy-determinism, play() driver
+(ported from SlushTest.java and SnowflakeTest.java)."""
+
+from wittgenstein_tpu.core.registries import builder_name, RANDOM
+from wittgenstein_tpu.protocols.slush import Slush, SlushParameters
+from wittgenstein_tpu.protocols.snowflake import Snowflake, SnowflakeParameters
+
+NB = builder_name(RANDOM, True, 0)
+NL = "NetworkLatencyByDistanceWJitter"
+
+
+class TestSlush:
+    def test_simple(self):
+        """All 100 nodes converge on one color in 10 s (SlushTest.java:13-24)."""
+        p = Slush(SlushParameters(100, 7, 7, 4.0 / 7.0, NB, NL))
+        p.init()
+        p.network().run(10)
+        assert len(p.network().all_nodes) == 100
+        unique_color = p.network().get_node_by_id(0).my_color
+        for n in p.network().all_nodes:
+            assert n.my_color == unique_color
+
+    def test_copy(self):
+        """p and p.copy() evolve identically (SlushTest.java:26-42)."""
+        p1 = Slush(SlushParameters(60, 5, 7, 4.0 / 7.0, NB, NL))
+        p2 = p1.copy()
+        p1.init()
+        p1.network().run_ms(200)
+        p2.init()
+        p2.network().run_ms(200)
+        for n1 in p1.network().all_nodes:
+            n2 = p2.network().get_node_by_id(n1.node_id)
+            assert n2 is not None
+            assert n1.my_color == n2.my_color
+            assert n1.my_query_nonce == n2.my_query_nonce
+            assert n1.round == n2.round
+
+    def test_play(self, tmp_path):
+        p1 = Slush(SlushParameters(120, 5, 7, 4.0 / 7.0, NB, NL))
+        p1.play(graph_path=str(tmp_path / "slush.png"))
+        assert (tmp_path / "slush.png").exists()
+
+
+class TestSnowflake:
+    def test_simple(self):
+        p = Snowflake(SnowflakeParameters(100, 5, 7, 4.0 / 7.0, 3, NB, NL))
+        p.init()
+        p.network().run(10)
+        assert len(p.network().all_nodes) == 100
+        unique_color = p.network().get_node_by_id(0).my_color
+        for n in p.network().all_nodes:
+            assert n.my_color == unique_color
+
+    def test_copy(self):
+        p1 = Snowflake(SnowflakeParameters(60, 5, 7, 4.0 / 7.0, 3, NB, NL))
+        p2 = p1.copy()
+        p1.init()
+        p1.network().run_ms(200)
+        p2.init()
+        p2.network().run_ms(200)
+        for n1 in p1.network().all_nodes:
+            n2 = p2.network().get_node_by_id(n1.node_id)
+            assert n2 is not None
+            assert n1.my_color == n2.my_color
+            assert n1.my_query_nonce == n2.my_query_nonce
+            assert n1.cnt == n2.cnt
+
+    def test_play(self, tmp_path):
+        p1 = Snowflake(SnowflakeParameters(100, 5, 7, 4.0 / 7.0, 3, NB, NL))
+        p1.play(graph_path=str(tmp_path / "snowflake.png"))
+        assert (tmp_path / "snowflake.png").exists()

@@ -7,6 +7,24 @@ Importing this package registers every protocol in
 wittgenstein_tpu.core.params.protocol_registry (the API-discovery contract).
 """
 
-from . import pingpong  # noqa: F401
+from . import (  # noqa: F401
+    gsf,
+    handel,
+    optimistic_p2p_signature,
+    p2pflood,
+    paxos,
+    pingpong,
+    slush,
+    snowflake,
+)
 
-__all__ = ["pingpong"]
+__all__ = [
+    "gsf",
+    "handel",
+    "optimistic_p2p_signature",
+    "p2pflood",
+    "paxos",
+    "pingpong",
+    "slush",
+    "snowflake",
+]

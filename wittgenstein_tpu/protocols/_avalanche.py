@@ -1,0 +1,148 @@
+"""Shared pieces of the Avalanche family (Slush / Snowflake): query/answer
+messages, the sampling node base, and the colored-node scenario driver.
+
+Reference semantics: the Query/AnswerQuery/Answer inner classes and node
+sampling loops are identical between protocols/Slush.java:86-220 and
+protocols/Snowflake.java:95-232; only onAnswer's accounting differs (round/M
+vs cnt/B), which stays in the concrete protocol modules.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..core import stats as SH
+from ..core.node import Node
+from ..core.runners import ProgressPerTime
+from ..oracle.messages import Message
+
+COLOR_NB = 2
+
+
+class Query(Message):
+    def __init__(self, id_: int, color: int):
+        self.id = id_
+        self.color = color
+
+    def action(self, network, from_node, to_node):
+        to_node.on_query(self, from_node)
+
+
+class AnswerQuery(Message):
+    def __init__(self, original_query: Query, color: int):
+        self.original_query = original_query
+        self.color = color
+
+    def action(self, network, from_node, to_node):
+        to_node.on_answer(self.original_query.id, self.color)
+
+
+class Answer:
+    __slots__ = ("round", "colors_found")
+
+    def __init__(self, round_: int):
+        self.round = round_
+        self.colors_found = [0] * (COLOR_NB + 1)
+
+    def answer_count(self) -> int:
+        return sum(self.colors_found)
+
+
+class AvalancheNode(Node):
+    """Sampling node base: uncolored nodes adopt the first color they are
+    queried with; every node answers with its current color; K distinct
+    random remotes per query (Slush.java:126-154 == Snowflake.java:136-159).
+
+    The concrete protocol provides on_answer()."""
+
+    __slots__ = ("my_color", "my_query_nonce", "answer_ip", "_p")
+
+    def __init__(self, p):
+        super().__init__(p.network().rd, p.nb)
+        self.my_color = 0
+        self.my_query_nonce = 0
+        self.answer_ip: Dict[int, Answer] = {}
+        self._p = p
+
+    def random_remotes(self) -> List["AvalancheNode"]:
+        p, net = self._p, self._p.network()
+        res: List[AvalancheNode] = []
+        while len(res) != p.params.k:
+            r = net.rd.next_int(p.params.nodes_av)
+            if r != self.node_id and net.get_node_by_id(r) not in res:
+                res.append(net.get_node_by_id(r))
+        return res
+
+    def _other_color(self) -> int:
+        return 2 if self.my_color == 1 else 1
+
+    def on_query(self, qa: Query, from_node: "AvalancheNode") -> None:
+        if self.my_color == 0:
+            self.my_color = qa.color
+            self.send_query(1)
+        self._p.network().send(AnswerQuery(qa, self.my_color), self, from_node)
+
+    def on_answer(self, query_id: int, color: int) -> None:
+        raise NotImplementedError
+
+    def send_query(self, count_in_m: int) -> None:
+        self.my_query_nonce += 1
+        q = Query(self.my_query_nonce, self.my_color)
+        self.answer_ip[q.id] = Answer(count_in_m)
+        self._p.network().send(q, self, self.random_remotes())
+
+
+def dominant_color(nodes) -> List[int]:
+    colors = [0, 0, 0]
+    for n in nodes:
+        colors[n.my_color] += 1
+    return colors
+
+
+def init_two_colors(protocol, node_factory) -> None:
+    """Shared init: build nodes_av nodes, color node 0 red and node 1 blue,
+    both start querying (Slush.java:62-74 == Snowflake.java:76-88)."""
+    net = protocol.network()
+    for _ in range(protocol.params.nodes_av):
+        net.add_node(node_factory(protocol))
+    uncolored1 = net.get_node_by_id(0)
+    uncolored2 = net.get_node_by_id(1)
+    uncolored1.my_color = 1
+    uncolored1.send_query(1)
+    uncolored2.my_color = 2
+    uncolored2.send_query(1)
+
+
+def color_play(protocol, node_continues, graph_path: Optional[str], verbose: bool):
+    """The shared `play` driver: per-10ms colored-node series, 10 rounds,
+    continue while any node still iterates and neither color holds exactly
+    100 nodes — the reference's hardcoded-100 quirk, kept
+    (Slush.java:222-268 == Snowflake.java:234-282)."""
+
+    class _Getter(SH.StatsGetter):
+        def fields(self):
+            return ["avg"]
+
+        def get(self, live_nodes):
+            colors = dominant_color(live_nodes)
+            if verbose:
+                print(
+                    f"Colored nodes by the numbers: {colors[0]} remain uncolored "
+                    f"{colors[1]} are red {colors[2]} are blue."
+                )
+            return SH.get_stats_on(live_nodes, lambda n: colors[n.my_color])
+
+    ppt = ProgressPerTime(
+        protocol, "", "Number of y-Colored Nodes", _Getter(), 10, None, 10, verbose
+    )
+
+    def cont_if(p1) -> bool:
+        colors = dominant_color(p1.network().all_nodes)
+        for gn in p1.network().all_nodes:
+            if (node_continues(gn) and colors[1] != 100) or (
+                node_continues(gn) and colors[2] != 100
+            ):
+                return True
+        return False
+
+    return ppt.run(cont_if, graph_path)

@@ -1,0 +1,113 @@
+"""OptimisticP2PSignature: the simplest signature exchange — flood every
+signature over the P2P graph, finish at threshold (aggregation checked
+optimistically at the end).
+
+Reference semantics: protocols/OptimisticP2PSignature.java (SendSig message
+:86-103, node flood-on-first-sight :114-133, init registers a self-sig task
+per node at t=1 :156-165).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..core.params import WParameters, register_protocol
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..oracle.messages import Message
+from ..oracle.network import Network, Protocol
+from ..oracle.p2p import P2PNetwork, P2PNode
+
+
+@dataclasses.dataclass
+class OptimisticP2PSignatureParameters(WParameters):
+    node_count: int = 100
+    threshold: int = 99
+    connection_count: int = 20
+    pairing_time: int = 1
+    node_builder_name: Optional[str] = None
+    network_latency_name: Optional[str] = None
+
+
+class SendSig(Message):
+    def __init__(self, who: "P2PSigNode"):
+        self.sig = who.node_id
+
+    def size(self) -> int:
+        return 4 + 48  # NodeId + sig
+
+    def action(self, network, from_node, to_node):
+        to_node.on_sig(from_node, self)
+
+
+class P2PSigNode(P2PNode):
+    __slots__ = ("verified_signatures", "done", "_p")
+
+    def __init__(self, p: "OptimisticP2PSignature"):
+        super().__init__(p.network().rd, p.nb)
+        self.verified_signatures = 0  # int-as-bitset
+        self.done = False
+        self._p = p
+
+    def on_sig(self, from_node: "P2PSigNode", ss: SendSig) -> None:
+        """Forward each unseen sig to all peers but the sender; finish at
+        threshold with a 2*pairingTime verification delay
+        (OptimisticP2PSignature.java:114-133)."""
+        params, net = self._p.params, self._p.network()
+        if not self.done and not (self.verified_signatures >> ss.sig) & 1:
+            self.verified_signatures |= 1 << ss.sig
+            dests = [n for n in self.peers if n is not from_node]
+            net.send(ss, net.time + 1, self, dests)
+            if self.verified_signatures.bit_count() >= params.threshold:
+                self.done = True
+                self.done_at = net.time + params.pairing_time * 2
+
+    def __repr__(self) -> str:
+        return (
+            f"P2PSigNode{{nodeId={self.node_id}, doneAt={self.done_at}, "
+            f"sigs={self.verified_signatures.bit_count()}, msgReceived={self.msg_received}, "
+            f"msgSent={self.msg_sent}, KBytesSent={self.bytes_sent // 1024}, "
+            f"KBytesReceived={self.bytes_received // 1024}}}"
+        )
+
+
+@register_protocol("OptimisticP2PSignature", OptimisticP2PSignatureParameters)
+class OptimisticP2PSignature(Protocol):
+    def __init__(self, params: OptimisticP2PSignatureParameters):
+        self.params = params
+        self._network: P2PNetwork[P2PSigNode] = P2PNetwork(params.connection_count, False)
+        self.nb = registry_node_builders.get_by_name(params.node_builder_name)
+        self._network.set_network_latency(
+            registry_network_latencies.get_by_name(params.network_latency_name)
+        )
+
+    def copy(self) -> "OptimisticP2PSignature":
+        return OptimisticP2PSignature(self.params)
+
+    def init(self) -> None:
+        for _ in range(self.params.node_count):
+            n = P2PSigNode(self)
+            self._network.add_node(n)
+            self._network.register_task(
+                (lambda nn: lambda: nn.on_sig(nn, SendSig(nn)))(n), 1, n
+            )
+        self._network.set_peers()
+
+    def network(self) -> Network:
+        return self._network
+
+
+def main():
+    nb = None
+    nl = "NetworkLatencyByDistanceWJitter"
+    p2ps = OptimisticP2PSignature(
+        OptimisticP2PSignatureParameters(1000, 1000 // 2 + 1, 13, 3, nb, nl)
+    )
+    p2ps.init()
+    observer = p2ps.network().get_node_by_id(0)
+    p2ps.network().run(5)
+    print(observer)
+
+
+if __name__ == "__main__":
+    main()

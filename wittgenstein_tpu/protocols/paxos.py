@@ -1,0 +1,348 @@
+"""Classic Paxos with a handful of acceptors and proposers; proposer
+timeouts via registerTask, seq numbers partitioned by proposer rank.
+
+Reference semantics: protocols/Paxos.java (messages :43-145, AcceptorNode
+:153-207, ProposerNode :209-339, seq-number scheme :313-338, RunMultipleTimes
+driver `play` :394-519).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..core import stats as SH
+from ..core.node import Node
+from ..core.params import WParameters, register_protocol
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..core.runners import RunMultipleTimes
+from ..oracle.messages import Message
+from ..oracle.network import Network, Protocol
+
+MAX_VAL = 1000
+
+
+@dataclasses.dataclass
+class PaxosParameters(WParameters):
+    acceptor_count: int = 3
+    proposer_count: int = 3
+    timeout: int = 1000
+    node_builder: Optional[str] = None
+    latency: Optional[str] = None
+
+
+class Propose(Message):
+    def __init__(self, seq: int):
+        self.seq = seq
+
+    def action(self, network, from_node, to_node):
+        to_node.on_propose(from_node, self)
+
+
+class Reject(Message):
+    def __init__(self, seq_rejected: int, seq_accepted: int):
+        self.seq_rejected = seq_rejected
+        self.seq_accepted = seq_accepted
+
+    def action(self, network, from_node, to_node):
+        to_node.on_reject(self.seq_rejected, self.seq_accepted)
+
+
+class Agree(Message):
+    def __init__(self, your_seq: int, accepted_seq: Optional[int], accepted_val: Optional[int]):
+        self.your_seq = your_seq
+        self.accepted_seq = accepted_seq
+        self.accepted_val = accepted_val
+
+    def action(self, network, from_node, to_node):
+        to_node.on_agree(self.your_seq, self.accepted_seq, self.accepted_val)
+
+
+class Commit(Message):
+    def __init__(self, seq: int, val: int):
+        self.seq = seq
+        self.val = val
+
+    def action(self, network, from_node, to_node):
+        to_node.on_commit(from_node, self.seq, self.val)
+
+
+class Accept(Message):
+    def __init__(self, your_seq: int):
+        self.your_seq = your_seq
+
+    def action(self, network, from_node, to_node):
+        to_node.on_accept(self.your_seq)
+
+
+class RejectOnCommit(Message):
+    def __init__(self, seq_rejected: int, seq_accepted: int):
+        self.seq_rejected = seq_rejected
+        self.seq_accepted = seq_accepted
+
+    def action(self, network, from_node, to_node):
+        to_node.on_reject_on_commit(self.seq_rejected, self.seq_accepted)
+
+
+class PaxosNode(Node):
+    __slots__ = ()
+
+
+class AcceptorNode(PaxosNode):
+    __slots__ = ("max_agreed", "accepted_seq", "accepted_val", "agreed_to", "_p")
+
+    def __init__(self, p: "Paxos"):
+        super().__init__(p.network().rd, p.nb)
+        self.max_agreed = -1
+        self.accepted_seq: Optional[int] = None
+        self.accepted_val: Optional[int] = None
+        self.agreed_to: Optional["ProposerNode"] = None
+        self._p = p
+
+    def on_propose(self, from_node, p_msg: Propose) -> None:
+        """First round (Paxos.java:163-177)."""
+        net = self._p.network()
+        if p_msg.seq < self.max_agreed:
+            net.send(Reject(p_msg.seq, self.max_agreed), self, from_node)
+        elif p_msg.seq == self.max_agreed:
+            # can't happen: no message duplication, no byzantine nodes
+            raise RuntimeError(f"{self} {p_msg}")
+        else:
+            a = Agree(p_msg.seq, self.accepted_seq, self.accepted_val)
+            self.max_agreed = p_msg.seq
+            self.agreed_to = from_node
+            net.send(a, self, from_node)
+
+    def on_commit(self, from_node, seq: int, val: int) -> None:
+        """Second round (Paxos.java:179-192)."""
+        net = self._p.network()
+        if seq != self.max_agreed or (self.accepted_val is not None and self.accepted_val != val):
+            net.send(RejectOnCommit(seq, self.max_agreed), self, from_node)
+        else:
+            self.accepted_val = val
+            self.accepted_seq = seq if self.accepted_seq is None else max(self.accepted_seq, seq)
+            net.send(Accept(seq), self, from_node)
+
+    def __repr__(self) -> str:
+        return (
+            f"AcceptorNode{{maxAgreed={self.max_agreed}, acceptedSeq={self.accepted_seq}, "
+            f"acceptedVal={self.accepted_val}, agreedTo={self.agreed_to}}}"
+        )
+
+
+class ProposerNode(PaxosNode):
+    __slots__ = (
+        "rank",
+        "value_proposed",
+        "value_accepted",
+        "accepted_seq_ip",
+        "accepted_val_ip",
+        "seq_ip",
+        "agree_count_ip",
+        "reject1_count_ip",
+        "accept_count_ip",
+        "reject2_count_ip",
+        "proposal_ip",
+        "seq_accepted",
+        "agree_count",
+        "reject1_count",
+        "reject2_count",
+        "timeout_count",
+        "_p",
+    )
+
+    def __init__(self, rank: int, p: "Paxos"):
+        super().__init__(p.network().rd, p.nb)
+        self.rank = rank
+        self.value_proposed = p.network().rd.next_int(MAX_VAL)
+        self.value_accepted: Optional[int] = None
+        self.accepted_seq_ip: Optional[int] = None
+        self.accepted_val_ip: Optional[int] = None
+        self.seq_ip = 0
+        self.agree_count_ip = 0
+        self.reject1_count_ip = 0
+        self.accept_count_ip = 0
+        self.reject2_count_ip = 0
+        self.proposal_ip = False
+        self.seq_accepted = 0
+        self.agree_count = 0
+        self.reject1_count = 0
+        self.reject2_count = 0
+        self.timeout_count = 0
+        self._p = p
+
+    def on_reject(self, seq: int, server_cur_seq: int) -> None:
+        if seq == self.seq_ip:
+            self.reject1_count_ip += 1
+            if self.reject1_count_ip == self._p.majority:
+                self.proposal_ip = False
+                self.seq_accepted = max(self.seq_accepted, server_cur_seq)
+                self.reject1_count += 1
+                self.start_next_proposal()
+
+    def on_agree(self, seq: int, accepted_seq: Optional[int], accepted_val: Optional[int]) -> None:
+        """Track the highest previously-accepted (seq, val) among agreeing
+        acceptors; on majority, commit that value or our own
+        (Paxos.java:250-268)."""
+        if seq == self.seq_ip and self.agree_count_ip < self._p.majority:
+            self.agree_count_ip += 1
+            if accepted_seq is not None:
+                if self.accepted_seq_ip is None or self.accepted_seq_ip < accepted_seq:
+                    self.accepted_seq_ip = accepted_seq
+                    self.accepted_val_ip = accepted_val
+            if self.agree_count_ip >= self._p.majority:
+                self.agree_count += 1
+                if self.accepted_val_ip is None:
+                    self.accepted_val_ip = self.value_proposed
+                c = Commit(self.seq_ip, self.accepted_val_ip)
+                self._send_to_acceptors(c, self._p.network().time + 1)
+
+    def on_accept(self, seq: int) -> None:
+        if seq == self.seq_ip and self.accept_count_ip < self._p.majority:
+            self.accept_count_ip += 1
+            if self.accept_count_ip >= self._p.majority:
+                self.proposal_ip = False
+                if self.accepted_val_ip is None:
+                    raise RuntimeError("accept without a value in progress")
+                if self.value_accepted is not None:
+                    raise RuntimeError("Already accepted a value")
+                self.value_accepted = self.accepted_val_ip
+                self.done_at = self._p.network().time
+
+    def on_reject_on_commit(self, seq: int, server_cur_seq: int) -> None:
+        if seq == self.seq_ip:
+            self.reject2_count_ip += 1
+            if self.reject2_count_ip == self._p.majority:
+                self.proposal_ip = False
+                self.seq_accepted = max(self.seq_accepted, server_cur_seq)
+                self.reject2_count += 1
+                self.start_next_proposal()
+
+    def _send_to_acceptors(self, m: Message, sent_time: int) -> None:
+        net = self._p.network()
+        dest = list(self._p.acceptors)
+        net.rd.shuffle(dest)
+        net.send(m, sent_time, self, dest)
+
+    def on_timeout(self, seq: int) -> None:
+        if seq == self.seq_ip and self.proposal_ip:
+            self.proposal_ip = False
+            self.timeout_count += 1
+            self.start_next_proposal()
+
+    def start_next_proposal(self) -> None:
+        """Seq scheme guaranteeing distinct, incremental seqs per proposer
+        (Paxos.java:313-338)."""
+        if self.proposal_ip:
+            raise RuntimeError("proposal already in progress")
+        self.accepted_seq_ip = None
+        self.accepted_val_ip = None
+        self.proposal_ip = True
+        self.agree_count_ip = 0
+        self.reject1_count_ip = 0
+        self.accept_count_ip = 0
+        self.reject2_count_ip = 0
+
+        pc = self._p.params.proposer_count
+        gap = self.seq_accepted % pc
+        new_seq_ip = self.seq_accepted + pc - gap + self.rank
+        self.seq_ip = new_seq_ip if new_seq_ip > self.seq_ip else self.seq_ip + pc
+
+        p_msg = Propose(self.seq_ip)
+        net = self._p.network()
+        sent_time = net.time + 1
+        self._send_to_acceptors(p_msg, sent_time)
+        seq = p_msg.seq
+        net.register_task(lambda: self.on_timeout(seq), sent_time + self._p.params.timeout, self)
+
+
+@register_protocol("Paxos", PaxosParameters)
+class Paxos(Protocol):
+    def __init__(self, params: PaxosParameters):
+        self.params = params
+        self._network: Network[PaxosNode] = Network()
+        self.acceptors: List[AcceptorNode] = []
+        self.proposers: List[ProposerNode] = []
+        self.majority = params.acceptor_count // 2 + 1
+        self.nb = registry_node_builders.get_by_name(params.node_builder)
+        self._network.set_network_latency(
+            registry_network_latencies.get_by_name(params.latency)
+        )
+
+    def network(self) -> Network:
+        return self._network
+
+    def copy(self) -> "Paxos":
+        return Paxos(self.params)
+
+    def init(self) -> None:
+        for _ in range(self.params.acceptor_count):
+            an = AcceptorNode(self)
+            self._network.add_node(an)
+            self.acceptors.append(an)
+        for i in range(self.params.proposer_count):
+            pn = ProposerNode(i, self)
+            self._network.add_node(pn)
+            self.proposers.append(pn)
+            pn.start_next_proposal()
+
+    def __str__(self) -> str:
+        return f"Paxos{{params={self.params}}}"
+
+    def play(self, verbose: bool = False):
+        """RunMultipleTimes driver: 10 reseeded runs, 5 s cap, final check
+        that all proposers accepted the same value (Paxos.java:394-519)."""
+
+        def proposer_stats(getter):
+            class _G(SH.SimpleStatsGetter):
+                def get(self, live_nodes):
+                    props = [n for n in live_nodes if isinstance(n, ProposerNode)]
+                    return SH.get_stats_on(props, getter)
+
+            return _G()
+
+        class _MsgR(SH.SimpleStatsGetter):
+            def get(self, live_nodes):
+                return SH.get_stats_on(live_nodes, lambda n: n.msg_received)
+
+        stats_to_get = [
+            proposer_stats(lambda p: p.done_at),
+            proposer_stats(lambda p: p.timeout_count),
+            proposer_stats(lambda p: p.reject1_count),
+            proposer_stats(lambda p: p.reject2_count),
+            _MsgR(),
+        ]
+
+        def final_check(paxos) -> bool:
+            val = None
+            for pn in paxos.proposers:
+                if val is None:
+                    val = pn.value_accepted
+                elif val != pn.value_accepted:
+                    return False
+            return True
+
+        rmt = RunMultipleTimes(self, 10, 5000, stats_to_get, final_check)
+
+        def cont(protocol) -> bool:
+            return any(
+                isinstance(n, ProposerNode) and n.done_at == 0
+                for n in protocol.network().all_nodes
+            )
+
+        res = rmt.run(cont)
+        if verbose:
+            da, to, r1, r2, mr = res
+            print(
+                f"{self}, doneAt=({da}), timeout=({to}), rejectRound1=({r1}), "
+                f"rejectRound2=({r2}), msg received=({mr})"
+            )
+        return res
+
+
+def main():
+    Paxos(PaxosParameters()).play(verbose=True)
+
+
+if __name__ == "__main__":
+    main()

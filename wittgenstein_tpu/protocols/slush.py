@@ -1,0 +1,93 @@
+"""Slush: one round of the Avalanche family — repeated random sampling with
+an alpha threshold, M rounds per node.
+
+Reference semantics: protocols/Slush.java (color flip at `> A*K` and the
+M-round counter :161-176; shared machinery in `_avalanche`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..core.params import WParameters, register_protocol
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..oracle.network import Network, Protocol
+from ._avalanche import AvalancheNode, color_play, init_two_colors
+
+
+@dataclasses.dataclass
+class SlushParameters(WParameters):
+    nodes_av: int = 100
+    m: int = 4  # number of rounds; grows logarithmically with n
+    k: int = 7  # sample size
+    a: float = 4.0  # alpha threshold
+    node_builder_name: Optional[str] = None
+    network_latency_name: Optional[str] = None
+
+    @property
+    def ak(self) -> float:
+        return self.k * self.a
+
+
+class SlushNode(AvalancheNode):
+    __slots__ = ("round",)
+
+    def __init__(self, p: "Slush"):
+        super().__init__(p)
+        self.round = 0
+
+    def on_answer(self, query_id: int, color: int) -> None:
+        """After K answers: flip if the other color got > A*K of them; keep
+        querying while round < M (Slush.java:161-176)."""
+        p = self._p
+        asw = self.answer_ip[query_id]
+        asw.colors_found[color] += 1
+        if asw.answer_count() == p.params.k:
+            del self.answer_ip[query_id]
+            if asw.colors_found[self._other_color()] > p.params.ak:
+                self.my_color = self._other_color()
+            if self.round < p.params.m:
+                self.round += 1
+                self.send_query(asw.round + 1)
+
+
+@register_protocol("Slush", SlushParameters)
+class Slush(Protocol):
+    def __init__(self, params: SlushParameters):
+        self.params = params
+        self._network: Network[SlushNode] = Network()
+        self.nb = registry_node_builders.get_by_name(params.node_builder_name)
+        self._network.set_network_latency(
+            registry_network_latencies.get_by_name(params.network_latency_name)
+        )
+
+    def init(self) -> None:
+        init_two_colors(self, SlushNode)
+
+    def network(self) -> Network:
+        return self._network
+
+    def copy(self) -> "Slush":
+        return Slush(self.params)
+
+    def __str__(self) -> str:
+        return (
+            f"Slush{{Nodes={self.params.nodes_av}, latency={self._network.network_latency}, "
+            f"M={self.params.m}, AK={self.params.ak}}}"
+        )
+
+    def play(self, graph_path: Optional[str] = None, verbose: bool = False):
+        """Scenario driver (Slush.java:222-268)."""
+        m = self.params.m
+        return color_play(self, lambda gn: gn.round < m, graph_path, verbose)
+
+
+def main():
+    Slush(SlushParameters(100, 5, 7, 4.0 / 7.0, None, None)).play(
+        graph_path="graph.png", verbose=True
+    )
+
+
+if __name__ == "__main__":
+    main()

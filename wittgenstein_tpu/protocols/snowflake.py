@@ -1,0 +1,98 @@
+"""Snowflake: Slush plus a confidence counter B — a node accepts once it has
+seen B consecutive successful same-color majorities.
+
+Reference semantics: protocols/Snowflake.java (counter reset on flip
+:170-188; shared machinery in `_avalanche`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..core.params import WParameters, register_protocol
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..oracle.network import Network, Protocol
+from ._avalanche import AvalancheNode, color_play, init_two_colors
+
+
+@dataclasses.dataclass
+class SnowflakeParameters(WParameters):
+    nodes_av: int = 100
+    m: int = 4
+    k: int = 7
+    a: float = 4.0
+    b: int = 7
+    node_builder_name: Optional[str] = None
+    network_latency_name: Optional[str] = None
+
+    @property
+    def ak(self) -> float:
+        return self.a * self.k
+
+
+class SnowflakeNode(AvalancheNode):
+    __slots__ = ("cnt",)
+
+    def __init__(self, p: "Snowflake"):
+        super().__init__(p)
+        self.cnt = 0
+
+    def on_answer(self, query_id: int, color: int) -> None:
+        """Snowflake loop (Snowflake.java:170-188): flip and reset cnt on an
+        opposing majority, increment cnt on a confirming one; keep querying
+        while cnt <= B."""
+        p = self._p
+        asw = self.answer_ip[query_id]
+        asw.colors_found[color] += 1
+        if asw.answer_count() == p.params.k:
+            del self.answer_ip[query_id]
+            if asw.colors_found[self._other_color()] > p.params.ak:
+                self.my_color = self._other_color()
+                self.cnt = 0
+            elif asw.colors_found[self.my_color] > p.params.ak:
+                self.cnt += 1
+            if self.cnt <= p.params.b:
+                self.send_query(asw.round + 1)
+
+
+@register_protocol("Snowflake", SnowflakeParameters)
+class Snowflake(Protocol):
+    def __init__(self, params: SnowflakeParameters):
+        self.params = params
+        self._network: Network[SnowflakeNode] = Network()
+        self.nb = registry_node_builders.get_by_name(params.node_builder_name)
+        self._network.set_network_latency(
+            registry_network_latencies.get_by_name(params.network_latency_name)
+        )
+
+    def init(self) -> None:
+        init_two_colors(self, SnowflakeNode)
+
+    def network(self) -> Network:
+        return self._network
+
+    def copy(self) -> "Snowflake":
+        return Snowflake(self.params)
+
+    def __str__(self) -> str:
+        return (
+            f"Snowflake{{nodes={self.params.nodes_av}, "
+            f"latency={self._network.network_latency}, M={self.params.m}, "
+            f"AK={self.params.ak}, B={self.params.b}}}"
+        )
+
+    def play(self, graph_path: Optional[str] = None, verbose: bool = False):
+        """Scenario driver (Snowflake.java:234-282)."""
+        b = self.params.b
+        return color_play(self, lambda gn: gn.cnt < b, graph_path, verbose)
+
+
+def main():
+    Snowflake(SnowflakeParameters(100, 5, 7, 4.0 / 7.0, 3, None, None)).play(
+        graph_path="graph.png", verbose=True
+    )
+
+
+if __name__ == "__main__":
+    main()
