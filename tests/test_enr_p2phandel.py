@@ -1,0 +1,155 @@
+"""ENRGossiping + P2PHandel tests (ported from ENRGossipingTest.java and
+P2PHandelTest.java)."""
+
+import pytest
+
+from wittgenstein_tpu.core.registries import builder_name, RANDOM
+from wittgenstein_tpu.core.runners import RunMultipleTimes
+from wittgenstein_tpu.protocols.enr_gossiping import ENRGossiping, ENRParameters
+from wittgenstein_tpu.protocols.p2phandel import (
+    P2PHandel,
+    P2PHandelParameters,
+    default_params,
+)
+from wittgenstein_tpu.utils.bitset import JavaBitSet
+
+NB = builder_name(RANDOM, True, 0)
+NL = "NetworkLatencyByDistanceWJitter"
+
+
+class TestENRGossiping:
+    def test_copy(self):
+        """ENRGossipingTest.java:16-39 (lighter config: the Java test's
+        10 ms gossip period over 10 sim-seconds is prohibitively slow in
+        Python; 50 ms over 3 s exercises the same paths)."""
+        p1 = ENRGossiping(ENRParameters(100, 50, 25, 15000, 2, 20, 0.4, 10, 5, 5, NB, NL))
+        p2 = p1.copy()
+        p1.init()
+        p1.network().run(3)
+        p2.init()
+        p2.network().run(3)
+        for n1 in p1.network().all_nodes:
+            n2 = p2.network().get_node_by_id(n1.node_id)
+            assert n2 is not None
+            assert n1.done_at == n2.done_at
+            assert n1.is_down() == n2.is_down()
+            assert len(n1.get_msg_received(-1)) == len(n2.get_msg_received(-1))
+            assert n1.x == n2.x
+            assert n1.y == n2.y
+            assert [p.node_id for p in n1.peers] == [p.node_id for p in n2.peers]
+
+    def test_ppt(self, tmp_path):
+        """ENRGossipingTest.java:41-75: the ProgressPerTime driver runs."""
+        import wittgenstein_tpu.core.stats as SH
+
+        p1 = ENRGossiping(ENRParameters(100, 50, 25, 15000, 2, 20, 0.4, 30, 10, 5, NB, NL))
+        from wittgenstein_tpu.core.runners import ProgressPerTime
+
+        class _G(SH.SimpleStatsGetter):
+            def get(self, live_nodes):
+                return SH.get_stats_on(live_nodes, lambda n: n.done_at)
+
+        ppt = ProgressPerTime(
+            p1, "", "Nodes that have found capabilities", _G(), 1, None, 5000, False
+        )
+        ppt.run(lambda pp1: pp1.network().time <= 1000 * 15, None)
+
+
+class TestP2PHandel:
+    def setup_method(self):
+        self.ps = P2PHandel(default_params(32, 0.0, 4, None, None))
+        self.ps.init()
+        self.n1 = self.ps.network().get_node_by_id(1)
+        self.n2 = self.ps.network().get_node_by_id(2)
+
+    def test_setup(self):
+        assert self.n1.verified_signatures.cardinality() == 1
+        assert self.n1.verified_signatures.get(self.n1.node_id)
+        assert len(self.n1.peers) >= 3
+
+    def test_repeatability(self):
+        params = P2PHandelParameters(100, 0, 25, 10, 2, 5, False, "dif", True, NB, NL)
+        p1 = P2PHandel(params)
+        p2 = P2PHandel(params)
+        p1.init()
+        p1.network().run(10)
+        p2.init()
+        p2.network().run(10)
+        for n in p1.network().all_nodes:
+            assert n.done_at == p2.network().get_node_by_id(n.node_id).done_at
+
+    def test_simple_run_without_state(self):
+        params = P2PHandelParameters(64, 0, 60, 3, 2, 5, True, "all", False, NB, NL)
+        p1 = P2PHandel(params)
+        p1.init()
+        cont = RunMultipleTimes.cont_until_done()
+        while cont(p1) and p1.network().time < 20000:
+            p1.network().run_ms(1000)
+        assert not cont(p1)
+
+    def test_simple_run_with_state(self):
+        params = P2PHandelParameters(20, 0, 20, 3, 2, 50, True, "cmp_diff", True, NB, NL)
+        p1 = P2PHandel(params)
+        p1.init()
+        cont = RunMultipleTimes.cont_until_done()
+        while cont(p1) and p1.network().time < 20000:
+            p1.network().run_ms(1000)
+        assert not cont(p1)
+
+    def test_check_sigs(self):
+        sigs = JavaBitSet()
+        sigs.set(self.n1.node_id)
+        sigs.set(0)
+        self.n1.to_verify.add(sigs)
+        self.ps.network().msgs.clear()
+        self.n1.check_sigs()
+        assert len(self.n1.to_verify) == 0
+        assert self.ps.network().msgs.size() == 1
+
+    def test_sig_update(self):
+        sigs = JavaBitSet()
+        sigs.set(self.n1.node_id)
+        sigs.set(0)
+        self.n1.update_verified_signatures(sigs)
+        assert self.n1.verified_signatures.cardinality() == 2
+
+    def test_compressed_size(self):
+        """P2PHandelTest.java:117-157."""
+        fs = JavaBitSet.from_string
+        cs = self.ps.compressed_size
+        assert cs(fs("1111")) == 1
+        assert cs(fs("1111 1111")) == 1
+        assert cs(fs("1111 1111 1111 1111")) == 1
+        assert cs(fs(
+            "0000 0000 0000 0000  0000 0000 0000 0000 1111 1111 1111 1111  1111 1111 1111 0000"
+        )) == 3
+        assert cs(fs(
+            "0000 0000 0000 0000  0000 0000 0000 0000 1111 1111 1111 1111  1111 1111 1111 1111 0000"
+        )) == 1
+        assert cs(fs(
+            "0000 0000 0000 0000  1111 1111 1111 1111 1111 1111 1111 1111  1111 1111 1111 1111 0000"
+        )) == 2
+        assert cs(fs("1111 1111 1111 1111  1111 1111 1111 0000")) == 3
+        assert cs(fs("1111 1111 0000")) == 1
+        assert cs(fs("0001 1111 1111 0000")) == 3
+        assert cs(fs("0001 1111 1111 1111")) == 3
+        assert cs(fs("0000 1111 1111 1111  0000")) == 2
+        assert cs(fs("1101 0111")) == 4
+        assert cs(fs("1111 1110")) == 3
+        assert cs(fs("0111 0111")) == 4
+        assert cs(fs("0000 0000")) == 0
+        assert cs(fs("1111 1111 1111")) == 2
+
+    def test_copy(self):
+        p1 = P2PHandel(P2PHandelParameters(500, 2, 60, 10, 2, 20, False, "dif", True, NB, NL))
+        p2 = p1.copy()
+        p1.init()
+        p1.network().run_ms(500)
+        p2.init()
+        p2.network().run_ms(500)
+        for n1 in p1.network().all_nodes:
+            n2 = p2.network().get_node_by_id(n1.node_id)
+            assert n2 is not None
+            assert n1.done_at == n2.done_at
+            assert n1.verified_signatures == n2.verified_signatures
+            assert n1.to_verify == n2.to_verify

@@ -1,0 +1,115 @@
+"""SanFermin family: helper candidate sets (ported from SanFerminTest.java),
+plus liveness and copy-determinism for both protocol variants."""
+
+from wittgenstein_tpu.core.node import Node, NodeBuilder
+from wittgenstein_tpu.protocols.sanfermin import (
+    SanFerminSignature,
+    SanFerminSignatureParameters,
+)
+from wittgenstein_tpu.protocols.sanfermin_cappos import (
+    SanFerminCappos,
+    SanFerminParameters,
+)
+from wittgenstein_tpu.protocols.sanfermin_helper import SanFerminHelper
+from wittgenstein_tpu.utils.javarand import JavaRandom
+
+
+def _make_nodes(count=8):
+    nb = NodeBuilder()
+    return [Node(JavaRandom(0), nb) for _ in range(count)]
+
+
+class TestSanFerminHelper:
+    def test_candidate_set(self):
+        """SanFerminTest.java:25-46."""
+        all_nodes = _make_nodes()
+        n1 = all_nodes[1]
+        helper = SanFerminHelper(n1, all_nodes, JavaRandom(0))
+
+        set2 = helper.get_candidate_set(2)
+        assert all_nodes[0] in set2
+
+        set1 = helper.get_candidate_set(1)
+        assert all_nodes[3] in set1
+        assert all_nodes[0] not in set1
+
+        set0 = helper.get_candidate_set(0)
+        assert all_nodes[4] in set0
+        assert all_nodes[0] not in set0
+        assert all_nodes[3] not in set0
+
+        n4 = all_nodes[4]
+        helper4 = SanFerminHelper(n4, all_nodes, JavaRandom(0))
+        assert helper4.is_candidate(n1, 0)
+
+    def test_pick_next_nodes(self):
+        """SanFerminTest.java:48-58."""
+        all_nodes = _make_nodes()
+        n1 = all_nodes[1]
+        helper = SanFerminHelper(n1, all_nodes, JavaRandom(0))
+
+        set2 = helper.pick_next_nodes(2, 10)
+        assert all_nodes[0] in set2
+
+        set22 = helper.pick_next_nodes(2, 10)
+        assert set22 == []
+
+
+class TestSanFerminSignature:
+    def test_liveness(self):
+        """Bounded liveness: every node completes the binomial descent."""
+        p = SanFerminSignature(
+            SanFerminSignatureParameters(64, 64, 2, 48, 300, 1, False, None, None)
+        )
+        p.init()
+        p.network().run(30)
+        # Some nodes can run out of candidates mid-descent ("is OUT"), so
+        # full completion is not guaranteed — the reference's own javadoc
+        # example shows sigs=874 of 1024 (SanFerminSignature.java:20-22).
+        assert len(p.finished_nodes) >= 48
+        for n in p.finished_nodes:
+            assert n.done
+            assert n.done_at > 0
+            assert n.agg_value >= 1
+
+    def test_copy(self):
+        p1 = SanFerminSignature(
+            SanFerminSignatureParameters(32, 32, 2, 48, 300, 1, False, None, None)
+        )
+        p2 = p1.copy()
+        p1.init()
+        p1.network().run_ms(3000)
+        p2.init()
+        p2.network().run_ms(3000)
+        for n1 in p1.network().all_nodes:
+            n2 = p2.network().get_node_by_id(n1.node_id)
+            assert n2 is not None
+            assert n1.agg_value == n2.agg_value
+            assert n1.done_at == n2.done_at
+            assert n1.msg_sent == n2.msg_sent
+
+
+class TestSanFerminCappos:
+    def test_liveness(self):
+        p = SanFerminCappos(SanFerminParameters(64, 32, 2, 48, 150, 50, None, None))
+        p.init()
+        p.network().run(30)
+        # As with SanFerminSignature, stragglers that ran out of candidates
+        # may never finish; require a strong majority.
+        done = [n for n in p.all_nodes if n.done]
+        assert len(done) >= 48
+        for n in done:
+            assert n.total_number_of_sigs(-1) >= 1
+
+    def test_copy(self):
+        p1 = SanFerminCappos(SanFerminParameters(32, 16, 2, 48, 150, 50, None, None))
+        p2 = p1.copy()
+        p1.init()
+        p1.network().run_ms(3000)
+        p2.init()
+        p2.network().run_ms(3000)
+        for n1 in p1.network().all_nodes:
+            n2 = p2.network().get_node_by_id(n1.node_id)
+            assert n2 is not None
+            assert n1.done_at == n2.done_at
+            assert n1.msg_sent == n2.msg_sent

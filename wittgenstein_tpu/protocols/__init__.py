@@ -8,23 +8,31 @@ wittgenstein_tpu.core.params.protocol_registry (the API-discovery contract).
 """
 
 from . import (  # noqa: F401
+    enr_gossiping,
     gsf,
     handel,
     optimistic_p2p_signature,
     p2pflood,
+    p2phandel,
     paxos,
     pingpong,
+    sanfermin,
+    sanfermin_cappos,
     slush,
     snowflake,
 )
 
 __all__ = [
+    "enr_gossiping",
     "gsf",
     "handel",
     "optimistic_p2p_signature",
     "p2pflood",
+    "p2phandel",
     "paxos",
     "pingpong",
+    "sanfermin",
+    "sanfermin_cappos",
     "slush",
     "snowflake",
 ]

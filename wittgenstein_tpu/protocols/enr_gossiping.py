@@ -1,0 +1,321 @@
+"""ENRGossiping: EIP-778 node-record gossip — nodes flood versioned
+capability records (StatusFloodMessage) over a P2P overlay, connect to peers
+with matching capabilities, with churn (periodic capability changes, node
+join/leave).
+
+Reference semantics: protocols/ENRGossiping.java (Record message :199-217,
+ETHNode connectivity scoring :221-452, init + churn tasks :160-190,
+capSearch driver :454-492).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Set
+
+from ..core import stats as SH
+from ..core.params import WParameters, register_protocol
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..core.runners import ProgressPerTime
+from ..oracle.messages import StatusFloodMessage
+from ..oracle.network import Network, Protocol
+from ..oracle.p2p import P2PNetwork, P2PNode
+
+PEERS_PER_CAP = 3
+
+
+def _minutes_to_ms(mins: int) -> int:
+    return mins * 1000 * 60
+
+
+@dataclasses.dataclass
+class ENRParameters(WParameters):
+    time_to_change: int = _minutes_to_ms(10000)
+    cap_gossip_time: int = _minutes_to_ms(5)
+    discard_time: int = 100
+    time_to_leave: int = _minutes_to_ms(60)
+    total_peers: int = 5
+    nodes: int = 50
+    changing_nodes: float = 10
+    max_peers: int = 50
+    number_of_different_capabilities: int = 5
+    cap_per_node: int = 5
+    node_builder_name: Optional[str] = None
+    network_latency_name: Optional[str] = None
+
+
+class Record(StatusFloodMessage):
+    """Node record: seq + capability key set (ENRGossiping.java:199-217)."""
+
+    def __init__(self, source, msg_id, size, local_delay, delay_between_peers, seq, caps):
+        super().__init__(msg_id, seq, size, local_delay, delay_between_peers)
+        self.source = source
+        self.caps = caps
+
+
+class ETHNode(P2PNode):
+    __slots__ = ("capabilities", "records", "start_time", "_p")
+
+    def __init__(self, p: "ENRGossiping", capabilities: Set[str]):
+        super().__init__(p.network().rd, p.nb)
+        self.capabilities = capabilities
+        self.records = 0
+        self.start_time = 0
+        self._p = p
+
+    def is_fully_connected(self) -> bool:
+        """Score threshold + per-capability connectivity BFS
+        (ENRGossiping.java:226-248)."""
+        p, net = self._p, self._p.network()
+        if self.score_of(self.peers) < len(self.capabilities) * PEERS_PER_CAP:
+            return False
+        sorted_nodes = p.select_nodes_by_cap([e for e in net.all_nodes if not e.is_down()])
+        cap_keys = [k for k in sorted_nodes if k in self.capabilities]
+        for key in cap_keys:
+            cap_set = list(sorted_nodes[key])
+            if self.is_part_of_network(cap_set):
+                return False
+        return True
+
+    def added_value(self, p_node: "ETHNode") -> int:
+        s1 = self.score_of(self.peers)
+        added = list(self.peers)
+        added.append(p_node)
+        s2 = self.score_of(added)
+        return s2 - s1
+
+    def can_connect(self, p_node: "ETHNode") -> bool:
+        return not p_node.is_down() and len(p_node.peers) < self._p.params.max_peers
+
+    def start(self) -> None:
+        """Lifecycle hook: schedule exit (for late joiners) and periodic
+        capability broadcast (ENRGossiping.java:272-294)."""
+        super().start()
+        p, net = self._p, self._p.network()
+        self.start_time = net.time
+        if self.is_fully_connected():
+            self.set_done_at(self)
+        start_exit = 2**31 - 1
+        if net.time > 1:
+            # initial nodes never exit: keeps the simulation simpler
+            start_exit = net.time + net.rd.next_int(p.params.time_to_leave)
+            net.register_task(self.exit_network, start_exit, self)
+        start_broadcast = net.time + net.rd.next_int(p.params.cap_gossip_time) + 1
+        if start_broadcast < start_exit:
+            net.register_periodic_task(
+                self.broadcast_capabilities, start_broadcast, p.params.cap_gossip_time, self
+            )
+
+    def on_flood(self, from_node, flood_message) -> None:
+        """Evaluate the source of an incoming record as a new peer
+        (ENRGossiping.java:296-322)."""
+        rc = flood_message
+        if not self.can_connect(rc.source):
+            return
+        if rc.source in self.peers:
+            return
+        added_value = self.added_value(rc.source)
+        if added_value == 0:
+            return
+        if len(self.peers) >= self._p.params.max_peers:
+            if not self.remove_worse_if_possible(rc.source):
+                return
+        self.connect(rc.source)
+
+    def set_done_at(self, n: "ETHNode") -> None:
+        net = self._p.network()
+        if n.done_at == 0 and self.is_fully_connected():
+            n.done_at = max(1, net.time - n.start_time)
+
+    def is_part_of_network(self, nodes_by_cap: List["ETHNode"]) -> bool:
+        """BFS over same-capability peers; true if we reach FEWER than half
+        (ENRGossiping.java:330-360)."""
+        threshold = len(nodes_by_cap) // 2
+        queue: Set[ETHNode] = set(n for n in nodes_by_cap if n in self.peers)
+        explored: Set[ETHNode] = {self}
+        while queue:
+            current = next(iter(queue))
+            if current is not self:
+                child_nodes = [
+                    n for n in nodes_by_cap if n in current.peers and n not in explored
+                ]
+                queue.remove(current)
+                queue.update(child_nodes)
+                explored.add(current)
+            else:
+                queue.remove(current)
+        return len(explored) < threshold
+
+    def connect(self, n: "ETHNode") -> None:
+        self._p.network().create_link(self, n)
+        self.set_done_at(self)
+        self.set_done_at(n)
+
+    def broadcast_capabilities(self) -> None:
+        net = self._p.network()
+        r = Record(self, self.node_id, 1, 10, 10, self.records, self.capabilities)
+        self.records += 1
+        net.send(r, self, self.peers)
+
+    def change_cap(self) -> None:
+        net = self._p.network()
+        self.capabilities = self._p.generate_cap()
+        r = Record(self, self.node_id, 1, 10, 10, self.records, self.capabilities)
+        self.records += 1
+        net.send(r, self, self.peers)
+
+    def score_of(self, peers: List["ETHNode"]) -> int:
+        """Matching-capability score, each cap counted at most PEERS_PER_CAP
+        times (ENRGossiping.java:395-409)."""
+        found: List[str] = []
+        for n in peers:
+            for s in n.capabilities:
+                if s in self.capabilities:
+                    found.append(s)
+        score = 0
+        for cap in found:
+            score += min(found.count(cap), PEERS_PER_CAP)
+        return score
+
+    def remove_worse_if_possible(self, replacement: "ETHNode") -> bool:
+        """(ENRGossiping.java:417-438)."""
+        to_remove = replacement
+        max_score = self.score_of(self.peers)
+        c_p = list(self.peers)
+        for i in range(len(self.peers)):
+            cur = c_p[i]
+            c_p[i] = replacement
+            score = self.score_of(c_p)
+            c_p[i] = cur
+            if score > max_score:
+                max_score = score
+                to_remove = cur
+        if to_remove is not replacement:
+            self._p.network().remove_link(self, to_remove)
+            return True
+        return False
+
+    def exit_network(self) -> None:
+        net = self._p.network()
+        live = sum(1 for n in net.all_nodes if not n.is_down())
+        if live <= self._p.params.total_peers:
+            raise RuntimeError(
+                f"We don't have enough peers left, live={live}, "
+                f"params.totalPeers={self._p.params.total_peers}"
+            )
+        net.disconnect(self)
+        net.get_node_by_id(self.node_id).stop()
+
+
+@register_protocol("ENRGossiping", ENRParameters)
+class ENRGossiping(Protocol):
+    def __init__(self, params: ENRParameters):
+        self.params = params
+        self._network: P2PNetwork[ETHNode] = P2PNetwork(params.total_peers, True)
+        self.nb = registry_node_builders.get_by_name(params.node_builder_name)
+        self._network.set_network_latency(
+            registry_network_latencies.get_by_name(params.network_latency_name)
+        )
+        self.changed_nodes: List[ETHNode] = []
+
+    def network(self) -> Network:
+        return self._network
+
+    def copy(self) -> "ENRGossiping":
+        return ENRGossiping(self.params)
+
+    def generate_cap(self) -> Set[str]:
+        caps: Set[str] = set()
+        while len(caps) < self.params.cap_per_node:
+            cap = self._network.rd.next_int(self.params.number_of_different_capabilities)
+            caps.add(f"cap_{cap}")
+        return caps
+
+    def select_nodes_by_cap(self, nodes: List[ETHNode]) -> Dict[str, List[ETHNode]]:
+        m: Dict[str, List[ETHNode]] = {}
+        for n in nodes:
+            for cap in n.capabilities:
+                m.setdefault(cap, []).append(n)
+        return m
+
+    def _select_changing_nodes(self) -> None:
+        # NOTE: multiplies totalPeers (not NODES) — reference quirk
+        # (ENRGossiping.java:142-148); duplicates allowed.
+        changing_cap_nodes = int(self.params.total_peers * self.params.changing_nodes)
+        self.changed_nodes = []
+        while len(self.changed_nodes) < changing_cap_nodes:
+            self.changed_nodes.append(
+                self._network.get_node_by_id(self._network.rd.next_int(self.params.total_peers))
+            )
+
+    def _add_new_node(self) -> None:
+        n = ETHNode(self, self.generate_cap())
+        self._network.add_node(n)
+        while len(n.peers) < self.params.total_peers:
+            peer_id = self._network.rd.next_int(len(self._network.all_nodes))
+            if not self._network.get_node_by_id(peer_id).is_down():
+                self._network.create_link(n, self._network.get_node_by_id(peer_id))
+        n.start()
+
+    def init(self) -> None:
+        for _ in range(self.params.nodes):
+            self._network.add_node(ETHNode(self, self.generate_cap()))
+        self._network.set_peers()
+
+        self._select_changing_nodes()
+        for n in self.changed_nodes:
+            start = self._network.rd.next_int(self.params.time_to_change) + 1
+            self._network.register_periodic_task(
+                n.change_cap, start, self.params.time_to_change, n
+            )
+        caps: Dict[str, int] = {}
+        for n in self._network.all_nodes:
+            for s in n.capabilities:
+                caps[s] = caps.get(s, 0) + 1
+        for v in caps.values():
+            if v == 1:
+                raise RuntimeError("Capabilities are not well distributed")
+        # Divided by 8 to aim for the expected value
+        self._network.register_periodic_task(
+            self._add_new_node, 0, self.params.time_to_leave // 8,
+            self._network.get_node_by_id(0),
+        )
+
+    def cap_search(self, max_time_ms: int = 1000 * 60 * 60 * 10, graph_path=None, verbose=False):
+        """Scenario driver (ENRGossiping.java:454-492): time for late-joining
+        nodes to find their capabilities."""
+        params = self.params
+
+        class _Getter(SH.StatsGetter):
+            def fields(self):
+                return ["min", "max", "avg"]
+
+            def get(self, live_nodes):
+                nodes = [n for n in live_nodes if n.node_id > params.nodes and n.done_at > 1]
+                if not nodes:
+                    return SH.SimpleStats(0, 0, 0)
+                return SH.get_stats_on(nodes, lambda n: n.done_at)
+
+        ppt = ProgressPerTime(
+            self, "", "Average time (in min) to find capabilities", _Getter(),
+            1, None, 1000 * 60 * 30, verbose,
+        )
+        return ppt.run(lambda p1: p1.network().time <= max_time_ms, graph_path)
+
+    def __str__(self) -> str:
+        p = self.params
+        return (
+            f"ENRGossiping{{timeToChange={p.time_to_change}, capGossipTime={p.cap_gossip_time}, "
+            f"discardTime={p.discard_time}, timeToLeave={p.time_to_leave}, "
+            f"totalPeers={p.total_peers}, NODES={p.nodes}, changingNodes={p.changing_nodes}, "
+            f"numberOfDifferentCapabilities={p.number_of_different_capabilities}, "
+            f"numberOfCapabilityPerNode={p.cap_per_node}}}"
+        )
+
+
+def main():
+    ENRGossiping(ENRParameters()).cap_search(verbose=True)
+
+
+if __name__ == "__main__":
+    main()

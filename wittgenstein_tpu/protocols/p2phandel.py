@@ -1,0 +1,338 @@
+"""P2PHandel: Handel-style aggregation on a generic P2P graph — nodes
+periodically push missing-signature sets to the neighbour with the largest
+diff, with four wire-compression strategies.
+
+Reference semantics: protocols/P2PHandel.java (State/SendSigs messages
+:119-253, range-compression size model :160-229, node logic :255-480, init
+tasks :482-509).  BitSet aliasing quirks (checkSigs2 mutating a message's
+shared bitset) are mirrored via utils.bitset.JavaBitSet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Set
+
+from ..core.params import WParameters, register_protocol
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..oracle.messages import Message
+from ..oracle.network import Network, Protocol
+from ..oracle.p2p import P2PNetwork, P2PNode
+from ..utils.bitset import JavaBitSet
+from ..utils.more_math import log2
+
+
+class SendSigsStrategy(enum.Enum):
+    all = "all"  # send all signatures, ignore peer state
+    dif = "dif"  # send just the diff
+    cmp_all = "cmp_all"  # send all, compressed
+    cmp_diff = "cmp_diff"  # compressed; diff if it compresses smaller
+
+
+@dataclasses.dataclass
+class P2PHandelParameters(WParameters):
+    signing_node_count: int = 100
+    relaying_node_count: int = 20
+    threshold: int = 99
+    connection_count: int = 40
+    pairing_time: int = 100
+    sigs_send_period: int = 1000
+    double_aggregate_strategy: bool = True
+    send_sigs_strategy: str = "dif"
+    send_state: bool = False
+    node_builder_name: Optional[str] = None
+    network_latency_name: Optional[str] = None
+
+    @property
+    def strategy(self) -> SendSigsStrategy:
+        s = self.send_sigs_strategy
+        return s if isinstance(s, SendSigsStrategy) else SendSigsStrategy(s)
+
+
+class State(Message):
+    """Peer-state broadcast; trailing zero bits are implicit for sizing
+    (P2PHandel.java:119-141)."""
+
+    def __init__(self, who: "P2PHandelNode"):
+        self.desc = who.verified_signatures.clone()
+        self.who = who
+
+    def size(self) -> int:
+        return max(1, self.desc.length() // 8)
+
+    def action(self, network, from_node, to_node):
+        to_node.on_peer_state(self)
+
+
+class SendSigs(Message):
+    def __init__(self, sigs: JavaBitSet, sig_count: Optional[int] = None):
+        if sig_count is None:
+            sig_count = sigs.cardinality()
+        self.sigs = sigs.clone()
+        self._size = max(1, sig_count)
+
+    def size(self) -> int:
+        return self._size
+
+    def action(self, network, from_node, to_node):
+        to_node.on_new_sig(from_node, self.sigs)
+
+
+class P2PHandelNode(P2PNode):
+    __slots__ = ("verified_signatures", "to_verify", "peers_state", "just_relay", "_p")
+
+    def __init__(self, p: "P2PHandel", just_relay: bool):
+        super().__init__(p.network().rd, p.nb)
+        self._p = p
+        self.verified_signatures = JavaBitSet()
+        self.to_verify: Set[JavaBitSet] = set()
+        self.peers_state: Dict[int, JavaBitSet] = {}
+        self.just_relay = just_relay
+        if not just_relay:
+            self.verified_signatures.set(self.node_id, True)
+
+    def start(self) -> None:
+        super().start()
+        # peer states start empty: we don't know who is a validator
+        for pr in self.peers:
+            self.peers_state[pr.node_id] = JavaBitSet()
+
+    def on_peer_state(self, state: State) -> None:
+        """Asynchronous, so the state can be an old one (P2PHandel.java:281-283)."""
+        self.peers_state[state.who.node_id].or_(state.desc)
+
+    def update_verified_signatures(self, sigs: JavaBitSet) -> None:
+        """(P2PHandel.java:290-303)."""
+        p, net = self._p.params, self._p.network()
+        old_card = self.verified_signatures.cardinality()
+        self.verified_signatures.or_(sigs)
+        new_card = self.verified_signatures.cardinality()
+        if new_card > old_card:
+            if self.done_at == 0 and self.verified_signatures.cardinality() >= p.threshold:
+                self.done_at = net.time
+                self.send_final_sig_to_peers()
+            elif self.done_at == 0 and p.send_state:
+                self.send_state_to_peers()
+
+    def send_final_sig_to_peers(self) -> None:
+        """Final aggregation to every peer still short of threshold; size 1
+        (P2PHandel.java:305-317)."""
+        p, net = self._p.params, self._p.network()
+        dest = []
+        for pr in self.peers:
+            if self.peers_state[pr.node_id].cardinality() < p.threshold:
+                dest.append(pr)
+                self.peers_state[pr.node_id].or_(self.verified_signatures)
+        net.send(SendSigs(self.verified_signatures, 1), self, dest)
+
+    def send_state_to_peers(self) -> None:
+        net = self._p.network()
+        net.send(State(self), self, self.peers)
+
+    def on_new_sig(self, from_node, sigs: JavaBitSet) -> None:
+        self.peers_state[from_node.node_id].or_(sigs)
+        self.to_verify.add(sigs)
+
+    def send_sigs(self) -> None:
+        """Periodic push to the peer with the largest diff
+        (P2PHandel.java:336-354)."""
+        net = self._p.network()
+        if self.done_at > 0:
+            return
+        dest = self._best_dest()
+        if dest is None:
+            return
+        to_send = self._diff(dest)
+        self.peers_state[dest.node_id].or_(self.verified_signatures)
+        ss = self._create_send_sigs(to_send)
+        net.send(ss, self, dest)
+
+    def _diff(self, peer: "P2PHandelNode") -> JavaBitSet:
+        needed = self.verified_signatures.clone()
+        needed.and_not(self.peers_state[peer.node_id])
+        return needed
+
+    def _best_dest(self) -> Optional["P2PHandelNode"]:
+        dest = None
+        dest_size = 0
+        for pr in self.peers:
+            size = self._diff(pr).cardinality()
+            if size > dest_size:
+                dest = pr
+                dest_size = size
+        return dest
+
+    def _create_send_sigs(self, to_send: JavaBitSet) -> SendSigs:
+        """(P2PHandel.java:389-404)."""
+        p = self._p
+        strat = p.params.strategy
+        if strat is SendSigsStrategy.dif:
+            return SendSigs(to_send)
+        elif strat is SendSigsStrategy.cmp_all:
+            return SendSigs(
+                self.verified_signatures.clone(), p.compressed_size(self.verified_signatures)
+            )
+        elif strat is SendSigsStrategy.cmp_diff:
+            s1 = p.compressed_size(self.verified_signatures)
+            s2 = p.compressed_size(to_send)
+            return SendSigs(self.verified_signatures.clone(), min(s1, s2))
+        else:
+            return SendSigs(self.verified_signatures.clone())
+
+    def check_sigs(self) -> None:
+        if self._p.params.double_aggregate_strategy:
+            self.check_sigs2()
+        else:
+            self.check_sigs1()
+
+    def check_sigs1(self) -> None:
+        """Strategy 1: verify the set with the most new signatures
+        (P2PHandel.java:419-447)."""
+        net = self._p.network()
+        best = None
+        best_v = 0
+        for o1 in list(self.to_verify):
+            oo1 = o1.clone()
+            oo1.and_not(self.verified_signatures)
+            v1 = oo1.cardinality()
+            if v1 == 0:
+                self.to_verify.discard(o1)
+            elif v1 > best_v:
+                best_v = v1
+                best = o1
+        if best is not None:
+            self.to_verify.discard(best)
+            t_best = best
+            net.register_task(
+                lambda: self.update_verified_signatures(t_best),
+                net.time + self._p.params.pairing_time * 2,
+                self,
+            )
+
+    def check_sigs2(self) -> None:
+        """Strategy 2: aggregate everything and verify once.  NOTE: or-ing
+        into the first element mutates a bitset possibly shared with other
+        nodes' toVerify sets — reference aliasing kept
+        (P2PHandel.java:455-479)."""
+        net = self._p.network()
+        agg = None
+        for o1 in self.to_verify:
+            if agg is None:
+                agg = o1
+            else:
+                agg.or_(o1)
+        self.to_verify.clear()
+        if agg is not None:
+            oo1 = agg.clone()
+            oo1.and_not(self.verified_signatures)
+            if oo1.cardinality() > 0:
+                t_best = agg
+                net.register_task(
+                    lambda: self.update_verified_signatures(t_best),
+                    net.time + self._p.params.pairing_time * 2,
+                    self,
+                )
+
+
+@register_protocol("P2PHandel", P2PHandelParameters)
+class P2PHandel(Protocol):
+    def __init__(self, params: P2PHandelParameters):
+        self.params = params
+        self._network: P2PNetwork[P2PHandelNode] = P2PNetwork(params.connection_count, False)
+        self.nb = registry_node_builders.get_by_name(params.node_builder_name)
+        self._network.set_network_latency(
+            registry_network_latencies.get_by_name(params.network_latency_name)
+        )
+
+    def compressed_size(self, sigs: JavaBitSet) -> int:
+        """Ranged-aggregation size model (P2PHandel.java:160-197)."""
+        if sigs.length() == self.params.signing_node_count:
+            return 1
+        first_one_at = -1
+        sig_ct = 0
+        pos = -1
+        compressing = False
+        was_compressing = False
+        while True:
+            pos += 1
+            if pos > sigs.length() + 1:
+                break
+            if not sigs.get(pos):
+                compressing = False
+                sig_ct -= self._merge_ranges(first_one_at, pos)
+                first_one_at = -1
+            elif compressing:
+                if (pos + 1) % 2 == 0:
+                    compressing = False
+                    was_compressing = True
+            else:
+                sig_ct += 1
+                if pos % 2 == 0:
+                    compressing = True
+                    if not was_compressing:
+                        first_one_at = pos
+                    else:
+                        was_compressing = False
+        return sig_ct
+
+    def _merge_ranges(self, first_one_at: int, pos: int) -> int:
+        """(P2PHandel.java:204-229)."""
+        if first_one_at < 0:
+            return 0
+        if first_one_at % 4 != 0:
+            first_one_at += 4 - (first_one_at % 4)
+        range_ct = (pos - first_one_at) // 2
+        if range_ct < 2:
+            return 0
+        max_ = log2(range_ct)
+        while max_ > 0:
+            size_in_blocks = 2 ** max_
+            size = size_in_blocks * 2
+            if first_one_at % size == 0:
+                return (size_in_blocks - 1) + self._merge_ranges(first_one_at + size, pos)
+            max_ -= 1
+        return 0
+
+    def init(self) -> None:
+        """(P2PHandel.java:482-509)."""
+        p, net = self.params, self._network
+        just_relay: Set[int] = set()
+        while len(just_relay) < p.relaying_node_count:
+            just_relay.add(net.rd.next_int(p.signing_node_count + p.relaying_node_count))
+
+        for i in range(p.signing_node_count + p.relaying_node_count):
+            n = P2PHandelNode(self, i in just_relay)
+            net.add_node(n)
+            if p.send_state:
+                net.register_task(n.send_state_to_peers, 1, n)
+            net.register_periodic_task(n.send_sigs, 1, p.sigs_send_period, n)
+            net.register_conditional_task(
+                n.check_sigs, 1, p.pairing_time, n,
+                (lambda nn: lambda: len(nn.to_verify) > 0)(n),
+                (lambda nn: lambda: nn.done_at == 0)(n),
+            )
+        net.set_peers()
+
+    def network(self) -> Network:
+        return self._network
+
+    def copy(self) -> "P2PHandel":
+        return P2PHandel(self.params)
+
+
+def default_params(
+    nodes: int,
+    dead_ratio: float = 0.0,
+    connection_count: Optional[int] = None,
+    tor=None,
+    loc=None,
+) -> P2PHandelParameters:
+    """P2PHandelScenarios.defaultParams (P2PHandelScenarios.java:261-277)."""
+    ts = int(nodes * 0.99)
+    from ..core.registries import CITIES, builder_name
+
+    nb = builder_name(CITIES, True, 0)
+    nl = "NetworkLatencyByCityWJitter"
+    cc = 10 if connection_count is None else connection_count
+    return P2PHandelParameters(nodes, 0, ts, cc, 4, 20, True, "dif", False, nb, nl)

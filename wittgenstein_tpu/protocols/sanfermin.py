@@ -1,0 +1,368 @@
+"""SanFerminSignature: binomial-tree pairwise BLS aggregation — each node
+swaps aggregate signatures with counterpart sets of decreasing common binary
+prefix, O(log n) contacts per node.
+
+Reference semantics: protocols/SanFerminSignature.java (swap request/reply
+state machine :229-323, timeout re-picks :329-369, goNextLevel level descent
+:379-419, pairing-time verification via registerTask :434-455).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Set
+
+from ..core import stats as SH
+from ..core.params import WParameters, register_protocol
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..core.node import Node
+from ..oracle.messages import Message
+from ..oracle.network import Network, Protocol
+from ..utils.more_math import log2
+from .sanfermin_helper import SanFerminHelper, to_binary_id
+
+
+@dataclasses.dataclass
+class SanFerminSignatureParameters(WParameters):
+    node_count: int = 32768 // 32
+    threshold: int = 32768 // 32
+    pairing_time: int = 2
+    signature_size: int = 48
+    reply_timeout: int = 300
+    candidate_count: int = 1
+    shuffled_lists: bool = False
+    node_builder_name: Optional[str] = None
+    network_latency_name: Optional[str] = None
+    verbose: bool = False
+
+    @property
+    def power_of_two(self) -> int:
+        return log2(self.node_count)
+
+
+class Status(enum.Enum):
+    OK = 0
+    NO = 1
+
+
+class SwapReply(Message):
+    def __init__(self, p: "SanFerminSignature", status: Status, level: int, agg_value: int):
+        self._p = p
+        self.status = status
+        self.level = level
+        self.agg_value = agg_value
+
+    def action(self, network, from_node, to_node):
+        to_node.on_swap_reply(from_node, self)
+
+    def size(self) -> int:
+        return 4 + self._p.params.signature_size  # uint32 + sig
+
+
+class SwapRequest(Message):
+    def __init__(self, p: "SanFerminSignature", level: int, agg_value: int):
+        self._p = p
+        self.level = level
+        self.agg_value = agg_value
+
+    def action(self, network, from_node, to_node):
+        to_node.on_swap_request(from_node, self)
+
+    def size(self) -> int:
+        return 4 + self._p.params.signature_size
+
+
+class SanFerminNode(Node):
+    __slots__ = (
+        "binary_id",
+        "current_prefix_length",
+        "candidate_tree",
+        "used_candidates",
+        "signature_cache",
+        "pending_nodes",
+        "futur_sigs",
+        "is_swapping",
+        "agg_value",
+        "threshold_at",
+        "threshold_done",
+        "done",
+        "sent_requests",
+        "received_requests",
+        "_p",
+    )
+
+    def __init__(self, p: "SanFerminSignature", nb):
+        super().__init__(p.network().rd, nb)
+        self._p = p
+        self.binary_id = to_binary_id(self, p.params.node_count)
+        self.used_candidates: Dict[int, Set[int]] = {}
+        self.candidate_tree: Optional[SanFerminHelper] = None
+        self.done = False
+        self.threshold_done = False
+        self.threshold_at = 0
+        self.sent_requests = 0
+        self.received_requests = 0
+        self.agg_value = 1
+        # start at n with N = 2^n; decreased by go_next_level
+        self.current_prefix_length = p.params.power_of_two
+        self.signature_cache: Dict[int, int] = {}
+        self.futur_sigs: Dict[int, int] = {}
+        self.pending_nodes: Optional[Set[int]] = None  # created in go_next_level
+        self.is_swapping = False
+
+    def on_swap_request(self, node: "SanFerminNode", request: SwapRequest) -> None:
+        """Fast path: the value is embedded in the request
+        (SanFerminSignature.java:229-270)."""
+        self.received_requests += 1
+        if self.done or request.level != self.current_prefix_length:
+            if request.level in self.signature_cache:
+                self._print(
+                    f"sending back CACHED signature at level {request.level} "
+                    f"to node {node.binary_id}"
+                )
+                # OPTIMISTIC REPLY
+                self._send_swap_reply(
+                    node, Status.OK, self.signature_cache[request.level], level=request.level
+                )
+            else:
+                self._send_swap_reply(node, Status.NO, 0)
+                # a value we might want to keep for later
+                is_candidate = node in self.candidate_tree.get_candidate_set(request.level)
+                is_valid_sig = True  # as always :)
+                if is_candidate and is_valid_sig:
+                    self.signature_cache[request.level] = request.agg_value
+            return
+
+        # just send the value but don't aggregate it — OPTIMISTIC reply
+        if self.is_swapping:
+            self._send_swap_reply(node, Status.OK, self.agg_value, level=request.level)
+            return
+
+        is_candidate = node in self.candidate_tree.get_candidate_set(self.current_prefix_length)
+        good_level = request.level == self.current_prefix_length
+        is_valid_sig = True
+        if is_candidate and good_level and is_valid_sig:
+            self._transition("valid swap REQUEST", node.binary_id, request.level, request.agg_value)
+        else:
+            self._print(
+                f" received  INVALID Swapfrom {node.binary_id} at level {request.level}"
+            )
+
+    def on_swap_reply(self, from_node: "SanFerminNode", reply: SwapReply) -> None:
+        """(SanFerminSignature.java:272-323)."""
+        p = self._p.params
+        if reply.level != self.current_prefix_length or self.done:
+            return
+        if self.is_swapping:
+            return
+
+        if reply.status is Status.OK:
+            if from_node.node_id not in self.pending_nodes:
+                is_candidate = from_node in self.candidate_tree.get_candidate_set(
+                    self.current_prefix_length
+                )
+                good_level = reply.level == self.current_prefix_length
+                is_valid_sig = True
+                if is_candidate and good_level and is_valid_sig:
+                    self._transition(
+                        "UNEXPECTED swap REPLY", from_node.binary_id, reply.level, reply.agg_value
+                    )
+                else:
+                    self._print(
+                        f" received UNEXPECTED - WRONG swap reply from {from_node.binary_id} "
+                        f"at level {reply.level}"
+                    )
+                return
+            # good valid honest answer!
+            self._transition("valid swap REPLY", from_node.binary_id, reply.level, reply.agg_value)
+        elif reply.status is Status.NO:
+            self._print(f" received SwapReply NO from {from_node.binary_id}")
+            if from_node.node_id in self.pending_nodes:
+                nodes = self.candidate_tree.pick_next_nodes(
+                    self.current_prefix_length, p.candidate_count
+                )
+                self._send_to_nodes(nodes)
+            else:
+                self._print(f" UNEXPECTED NO reply from {from_node.binary_id}")
+        else:
+            raise RuntimeError("That should never happen")
+
+    def _send_to_nodes(self, candidates: List["SanFerminNode"]) -> None:
+        """Swap request + reply-timeout task (SanFerminSignature.java:329-369)."""
+        p, net = self._p, self._p.network()
+        if not candidates:
+            # can happen with failing/malicious nodes: nothing better to do
+            self._print(" is OUT (no more nodes to pick)")
+            return
+
+        self.pending_nodes.update(n.node_id for n in candidates)
+        self.sent_requests += len(candidates)
+
+        r = SwapRequest(p, self.current_prefix_length, self.agg_value)
+        self._print(
+            " send SwapRequest to " + " - ".join(n.binary_id for n in candidates)
+        )
+        net.send(r, self, candidates)
+
+        curr_level = self.current_prefix_length
+
+        def on_timeout():
+            if not self.done and self.current_prefix_length == curr_level:
+                self._print(f"TIMEOUT of SwapRequest at level {curr_level}")
+                new_list = self.candidate_tree.pick_next_nodes(
+                    self.current_prefix_length, p.params.candidate_count
+                )
+                self._send_to_nodes(new_list)
+
+        net.register_task(on_timeout, net.time + p.params.reply_timeout, self)
+
+    def go_next_level(self) -> None:
+        """Decrease the common-prefix requirement by one and contact the new
+        candidate set (SanFerminSignature.java:379-419)."""
+        p, net = self._p, self._p.network()
+        if self.done:
+            return
+
+        enough_sigs = self.agg_value >= p.params.threshold
+        no_more_swap = self.current_prefix_length == 0
+
+        if enough_sigs and not self.threshold_done:
+            self._print(" --- THRESHOLD REACHED --- ")
+            self.threshold_done = True
+            self.threshold_at = net.time + p.params.pairing_time * 2
+
+        if no_more_swap and not self.done:
+            self._print(" --- FINISHED ---- protocol")
+            self.done_at = net.time + p.params.pairing_time * 2
+            p.finished_nodes.append(self)
+            self.done = True
+            return
+        self.current_prefix_length -= 1
+        self.signature_cache[self.current_prefix_length] = self.agg_value
+        self.is_swapping = False
+        self.pending_nodes = set()
+        if self.current_prefix_length in self.futur_sigs:
+            self._print(
+                f" FUTURe value at new level{self.current_prefix_length} saved. "
+                "Moving on directly !"
+            )
+            self.agg_value += self.futur_sigs[self.current_prefix_length]
+            self.go_next_level()
+            return
+        new_list = self.candidate_tree.pick_next_nodes(
+            self.current_prefix_length, p.params.candidate_count
+        )
+        self._send_to_nodes(new_list)
+
+    def _send_swap_reply(self, n: "SanFerminNode", s: Status, value: int, level=None) -> None:
+        if level is None:
+            level = self.current_prefix_length
+        r = SwapReply(self._p, s, level, value)
+        self._p.network().send(r, self, [n])
+
+    def _transition(self, type_: str, from_id: str, level: int, to_aggregate: int) -> None:
+        """Lock the level and aggregate after pairingTime
+        (SanFerminSignature.java:434-455)."""
+        p, net = self._p, self._p.network()
+        self.is_swapping = True
+
+        def do_aggregate():
+            before = self.agg_value
+            self.agg_value += to_aggregate
+            self._print(
+                f" received {type_} lvl={level} from {from_id} "
+                f"aggValue {before} -> {self.agg_value}"
+            )
+            self.go_next_level()
+
+        net.register_task(do_aggregate, net.time + p.params.pairing_time, self)
+
+    def _print(self, s: str) -> None:
+        if self._p.params.verbose:
+            net = self._p.network()
+            print(
+                f"t={net.time}, id={self.node_id}, lvl={self.current_prefix_length}, "
+                f"sent={self.msg_sent} -> {s}"
+            )
+
+    def __repr__(self) -> str:
+        return (
+            f"SanFerminNode{{nodeId={self.binary_id}, thresholdAt={self.threshold_at}, "
+            f"doneAt={self.done_at}, sigs={self.agg_value}, msgReceived={self.msg_received}, "
+            f"msgSent={self.msg_sent}, sentRequests={self.sent_requests}, "
+            f"receivedRequests={self.received_requests}, KBytesSent={self.bytes_sent // 1024}, "
+            f"KBytesReceived={self.bytes_received // 1024}}}"
+        )
+
+
+@register_protocol("SanFerminSignature", SanFerminSignatureParameters)
+class SanFerminSignature(Protocol):
+    def __init__(self, params: SanFerminSignatureParameters):
+        self.params = params
+        self._network: Network[SanFerminNode] = Network()
+        self.nb = registry_node_builders.get_by_name(params.node_builder_name)
+        self._network.set_network_latency(
+            registry_network_latencies.get_by_name(params.network_latency_name)
+        )
+        # nodes are built in the constructor, like the reference
+        # (SanFerminSignature.java:112-130)
+        self.all_nodes: List[SanFerminNode] = []
+        for _ in range(params.node_count):
+            n = SanFerminNode(self, self.nb)
+            self.all_nodes.append(n)
+            self._network.add_node(n)
+        for n in self.all_nodes:
+            n.candidate_tree = SanFerminHelper(n, self.all_nodes, self._network.rd)
+        self.finished_nodes: List[SanFerminNode] = []
+
+    def copy(self) -> "SanFerminSignature":
+        return SanFerminSignature(self.params)
+
+    def init(self) -> None:
+        for n in self.all_nodes:
+            self._network.register_task(n.go_next_level, 1, n)
+
+    def network(self) -> Network:
+        return self._network
+
+
+def sigs_per_time(node_ct: int = 1024, limit: int = 6000, graph_path: Optional[str] = None):
+    """Scenario main (SanFerminSignature.java:566-614)."""
+    from ..tools.graph import Graph, ReportLine, Series
+
+    ps1 = SanFerminSignature(
+        SanFerminSignatureParameters(node_ct, node_ct, 2, 48, 300, 1, False, None, None)
+    )
+    graph = Graph("number of sig per time", "time in ms", "sig count")
+    s_min, s_max, s_avg = (
+        Series("sig count - worse node"),
+        Series("sig count - best node"),
+        Series("sig count - avg"),
+    )
+    for s in (s_min, s_max, s_avg):
+        graph.add_serie(s)
+    ps1.init()
+    while ps1.network().time < limit:
+        ps1.network().run_ms(10)
+        st = SH.get_stats_on(ps1.all_nodes, lambda n: n.agg_value)
+        s_min.add_line(ReportLine(ps1.network().time, st.min))
+        s_max.add_line(ReportLine(ps1.network().time, st.max))
+        s_avg.add_line(ReportLine(ps1.network().time, st.avg))
+    if graph_path:
+        graph.save(graph_path)
+    print("bytes sent:", SH.get_stats_on(ps1.all_nodes, lambda n: n.bytes_sent))
+    print("bytes rcvd:", SH.get_stats_on(ps1.all_nodes, lambda n: n.bytes_received))
+    print("msg sent:", SH.get_stats_on(ps1.all_nodes, lambda n: n.msg_sent))
+    print("msg rcvd:", SH.get_stats_on(ps1.all_nodes, lambda n: n.msg_received))
+    print(
+        "done at:",
+        SH.get_stats_on(
+            ps1.network().all_nodes, lambda n: limit if n.done_at == 0 else n.done_at
+        ),
+    )
+    return ps1
+
+
+if __name__ == "__main__":
+    sigs_per_time()

@@ -1,0 +1,292 @@
+"""SanFerminCappos: San Fermin variant with multi-candidate swaps, per-level
+signature caches and a per-level timeout instead of per-request replies.
+
+Reference semantics: protocols/SanFerminCappos.java (onSwap state machine
+:201-241, tryNextNodes + timeout :248-296, goNextLevel :306-344,
+totalNumberOfSigs cache reduction :351-358, putCachedSig threshold check
+:382-393).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from ..core import stats as SH
+from ..core.params import WParameters, register_protocol
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..core.node import Node
+from ..oracle.messages import Message
+from ..oracle.network import Network, Protocol
+from ..utils.more_math import log2
+from .sanfermin_helper import SanFerminHelper, to_binary_id
+
+
+@dataclasses.dataclass
+class SanFerminParameters(WParameters):
+    node_count: int = 32768 // 16
+    threshold: int = 32768 // 32
+    pairing_time: int = 2
+    signature_size: int = 48
+    timeout: int = 150
+    candidate_count: int = 50
+    node_builder_name: Optional[str] = None
+    network_latency_name: Optional[str] = None
+    verbose: bool = False
+
+
+class Swap(Message):
+    def __init__(self, p: "SanFerminCappos", level: int, agg_value: int, want_reply: bool):
+        self._p = p
+        self.level = level
+        self.agg_value = agg_value
+        self.want_reply = want_reply
+
+    def action(self, network, from_node, to_node):
+        to_node.on_swap(from_node, self)
+
+    def size(self) -> int:
+        return 4 + self._p.params.signature_size
+
+
+class SanFerminNode(Node):
+    __slots__ = (
+        "binary_id",
+        "helper",
+        "current_prefix_length",
+        "signature_cache",
+        "is_swapping",
+        "agg_value",
+        "threshold_at",
+        "threshold_done",
+        "done",
+        "_p",
+    )
+
+    def __init__(self, p: "SanFerminCappos", nb):
+        super().__init__(p.network().rd, nb)
+        self._p = p
+        self.binary_id = to_binary_id(self, p.params.node_count)
+        self.helper: Optional[SanFerminHelper] = None
+        self.done = False
+        self.threshold_done = False
+        self.threshold_at = 0
+        self.agg_value = 1
+        self.is_swapping = False
+        self.current_prefix_length = log2(p.params.node_count)
+        self.signature_cache: Dict[int, List[int]] = {}
+
+    def on_swap(self, from_node: "SanFerminNode", swap: Swap) -> None:
+        """(SanFerminCappos.java:201-241)."""
+        want_reply = swap.want_reply
+        if self.done or swap.level != self.current_prefix_length:
+            is_value_cached = swap.level in self.signature_cache
+            if want_reply and is_value_cached:
+                self._print(
+                    f"sending back CACHED signature at level {swap.level} "
+                    f"to node {from_node.binary_id}"
+                )
+                self._send_swap(
+                    [from_node], swap.level, self._get_best_cached_sig(swap.level), False
+                )
+            else:
+                is_candidate = self.helper.is_candidate(from_node, swap.level)
+                is_valid_sig = True  # as always :)
+                if is_candidate and is_valid_sig:
+                    self._put_cached_sig(swap.level, swap.agg_value)
+            return
+
+        if want_reply:
+            self._send_swap(
+                [from_node], swap.level, self.total_number_of_sigs(swap.level), False
+            )
+
+        good_level = swap.level == self.current_prefix_length
+        is_candidate = self.helper.is_candidate(from_node, self.current_prefix_length)
+        is_valid_sig = True
+        if is_candidate and good_level and is_valid_sig:
+            if not self.is_swapping:
+                self._transition(
+                    " received valid SWAP ", from_node.binary_id, swap.level, swap.agg_value
+                )
+        else:
+            self._print(
+                f" received  INVALID Swapfrom {from_node.binary_id} at level {swap.level}"
+            )
+            self._print(f"   ---> {is_valid_sig} - {good_level} - {is_candidate}")
+
+    def _try_next_nodes(self, candidates: List["SanFerminNode"]) -> None:
+        """(SanFerminCappos.java:248-296)."""
+        p, net = self._p, self._p.network()
+        if not candidates:
+            self._print(" is OUT (no more nodes to pick)")
+            return
+        for n in candidates:
+            if not self.helper.is_candidate(n, self.current_prefix_length):
+                raise RuntimeError(
+                    f"currentPrefixlength={self.current_prefix_length} "
+                    f"vs helper.currentLevel={self.helper.current_level}"
+                )
+        self._print(
+            " send Swaps to " + " - ".join(n.binary_id for n in candidates)
+        )
+        self._send_swap(
+            candidates,
+            self.current_prefix_length,
+            self.total_number_of_sigs(self.current_prefix_length + 1),
+            True,
+        )
+
+        curr_level = self.current_prefix_length
+
+        def on_timeout():
+            if not self.done and self.current_prefix_length == curr_level:
+                self._print(f"TIMEOUT of SwapRequest at level {curr_level}")
+                next_nodes = self.helper.pick_next_nodes(
+                    self.current_prefix_length, p.params.candidate_count
+                )
+                self._try_next_nodes(next_nodes)
+
+        net.register_task(on_timeout, net.time + p.params.timeout, self)
+
+    def go_next_level(self) -> None:
+        """(SanFerminCappos.java:306-344)."""
+        p, net = self._p, self._p.network()
+        if self.done:
+            return
+
+        enough_sigs = self.total_number_of_sigs(self.current_prefix_length) >= p.params.threshold
+        no_more_swap = self.current_prefix_length == 0
+
+        if enough_sigs and not self.threshold_done:
+            self._print(" --- THRESHOLD REACHED --- ")
+            self.threshold_done = True
+            self.threshold_at = net.time + p.params.pairing_time * 2
+
+        if no_more_swap and not self.done:
+            self._print(" --- FINISHED ---- protocol")
+            self.done_at = net.time + p.params.pairing_time * 2
+            p.finished_nodes.append(self)
+            self.done = True
+            return
+        self.current_prefix_length -= 1
+        self.is_swapping = False
+
+        if self.current_prefix_length in self.signature_cache:
+            self._print(
+                f" FUTURe value at new level{self.current_prefix_length} saved. "
+                "Moving on directly !"
+            )
+            self.go_next_level()
+            return
+        new_nodes = self.helper.pick_next_nodes(
+            self.current_prefix_length, p.params.candidate_count
+        )
+        self._try_next_nodes(new_nodes)
+
+    def _send_swap(self, nodes: List["SanFerminNode"], level: int, value: int, want_reply: bool):
+        r = Swap(self._p, level, value, want_reply)
+        self._p.network().send(r, self, nodes)
+
+    def total_number_of_sigs(self, level: int) -> int:
+        """Sum of the best cached sig at each level >= `level`, + own sig
+        (SanFerminCappos.java:351-358)."""
+        return (
+            sum(max(v) for lvl, v in self.signature_cache.items() if lvl >= level) + 1
+        )
+
+    def _transition(self, type_: str, from_id: str, level: int, to_aggregate: int) -> None:
+        p, net = self._p, self._p.network()
+        self.is_swapping = True
+
+        def do_aggregate():
+            self._print(f" received {type_} lvl={level} from {from_id}")
+            self._put_cached_sig(level, to_aggregate)
+            self.go_next_level()
+
+        net.register_task(do_aggregate, net.time + p.params.pairing_time, self)
+
+    def _get_best_cached_sig(self, level: int) -> int:
+        return max(self.signature_cache.get(level, []))
+
+    def _put_cached_sig(self, level: int, value: int) -> None:
+        self.signature_cache.setdefault(level, []).append(value)
+        enough_sigs = self.total_number_of_sigs(self.current_prefix_length) >= self._p.params.threshold
+        if enough_sigs and not self.threshold_done:
+            self._print(" --- THRESHOLD REACHED --- ")
+            self.threshold_done = True
+            self.threshold_at = self._p.network().time + self._p.params.pairing_time * 2
+
+    def _print(self, s: str) -> None:
+        if self._p.params.verbose:
+            net = self._p.network()
+            print(
+                f"t={net.time}, id={self.binary_id}, lvl={self.current_prefix_length}, "
+                f"sent={self.msg_sent} -> {s}"
+            )
+
+    def __repr__(self) -> str:
+        return (
+            f"SanFerminNode{{nodeId={self.binary_id}, thresholdAt={self.threshold_at}, "
+            f"doneAt={self.done_at}, sigs={self.total_number_of_sigs(-1)}, "
+            f"msgReceived={self.msg_received}, msgSent={self.msg_sent}, "
+            f"KBytesSent={self.bytes_sent // 1024}, KBytesReceived={self.bytes_received // 1024}}}"
+        )
+
+
+@register_protocol("SanFerminCappos", SanFerminParameters)
+class SanFerminCappos(Protocol):
+    def __init__(self, params: SanFerminParameters):
+        self.params = params
+        self._network: Network[SanFerminNode] = Network()
+        self.nb = registry_node_builders.get_by_name(params.node_builder_name)
+        self._network.set_network_latency(
+            registry_network_latencies.get_by_name(params.network_latency_name)
+        )
+        self.all_nodes: List[SanFerminNode] = []
+        self.finished_nodes: List[SanFerminNode] = []
+
+    def network(self) -> Network:
+        return self._network
+
+    def init(self) -> None:
+        """Nodes are built in init (unlike SanFerminSignature, which builds
+        them in the constructor — a reference quirk; SanFerminCappos.java:120-134)."""
+        self.all_nodes = []
+        for _ in range(self.params.node_count):
+            n = SanFerminNode(self, self.nb)
+            self.all_nodes.append(n)
+            self._network.add_node(n)
+        for n in self.all_nodes:
+            n.helper = SanFerminHelper(n, self.all_nodes, self._network.rd)
+        self.finished_nodes = []
+        for n in self.all_nodes:
+            self._network.register_task(n.go_next_level, 1, n)
+
+    def copy(self) -> "SanFerminCappos":
+        return SanFerminCappos(self.params)
+
+
+def sigs_per_time(node_ct: int = 1024, limit: int = 6000, graph_path: Optional[str] = None):
+    """Scenario main (SanFerminCappos.java:465-518)."""
+    from ..core.registries import RANDOM, builder_name
+
+    nl = "NetworkLatencyByDistanceWJitter"
+    nb = builder_name(RANDOM, True, 0)
+    ps1 = SanFerminCappos(SanFerminParameters(node_ct, node_ct // 2, 2, 48, 150, 50, nb, nl))
+    ps1.init()
+    while ps1.network().time < limit:
+        ps1.network().run_ms(10)
+    print("bytes sent:", SH.get_stats_on(ps1.all_nodes, lambda n: n.bytes_sent))
+    print("msg sent:", SH.get_stats_on(ps1.all_nodes, lambda n: n.msg_sent))
+    print(
+        "done at:",
+        SH.get_stats_on(
+            ps1.network().all_nodes, lambda n: limit if n.done_at == 0 else n.done_at
+        ),
+    )
+    return ps1
+
+
+if __name__ == "__main__":
+    sigs_per_time()

@@ -1,0 +1,119 @@
+"""Binary-id interval machinery shared by the San Fermin protocols.
+
+Reference semantics: protocols/SanFerminHelper.java — own-set / candidate-set
+interval halving over the binary node id (:46-96), used-node tracking with
+the quirky post-removal index filter of pickNextNodes (:123-157), and the
+left-padded binary id (:159-172).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, TypeVar
+
+from ..utils.javarand import JavaRandom
+from ..utils.more_math import log2
+
+T = TypeVar("T")
+
+
+def to_binary_id(node, set_size: int) -> str:
+    """Node id as a log2(setSize)-wide binary string
+    (SanFerminHelper.toBinaryID)."""
+    width = log2(set_size)
+    s = format(node.node_id, "b")
+    if len(s) > width:
+        raise ValueError(f"id {node.node_id} does not fit in {width} bits")
+    return s.rjust(width, "0")
+
+
+class SanFerminHelper:
+    """Tracks contacted nodes per level and computes own/candidate sets."""
+
+    def __init__(self, n, all_nodes: List, rd: JavaRandom):
+        self.n = n
+        self.binary_id = to_binary_id(n, len(all_nodes))
+        self.all_nodes = all_nodes
+        self.used_nodes: Dict[int, Set[int]] = {}
+        self.rd = rd
+        self.current_level = log2(len(all_nodes))
+
+    def _interval(self, level: int, swap_at_level: bool) -> tuple:
+        """The shared halving loop of getOwnSet/getCandidateSet
+        (SanFerminHelper.java:46-96); swap_at_level flips the branch when
+        currLevel == level (candidate set)."""
+        min_ = 0
+        max_ = len(self.all_nodes)
+        curr_level = 0
+        while curr_level <= level and min_ <= max_:
+            m = (max_ + min_) // 2
+            c = self.binary_id[curr_level]
+            if c == "0":
+                if swap_at_level and curr_level == level:
+                    min_ = m
+                else:
+                    max_ = m
+            elif c == "1":
+                if swap_at_level and curr_level == level:
+                    max_ = m
+                else:
+                    min_ = m
+            if max_ == min_:
+                break
+            if max_ - 1 == 0 or min_ == len(self.all_nodes):
+                break
+            curr_level += 1
+        return min_, max_
+
+    def get_own_set(self, level: int) -> List:
+        min_, max_ = self._interval(level, swap_at_level=False)
+        return self.all_nodes[min_:max_]
+
+    def get_candidate_set(self, level: int) -> List:
+        min_, max_ = self._interval(level, swap_at_level=True)
+        return self.all_nodes[min_:max_]
+
+    def is_candidate(self, node, level: int) -> bool:
+        return node in self.get_candidate_set(level)
+
+    def get_exact_candidate_node(self, level: int):
+        own = self.get_own_set(level)
+        idx = own.index(self.n)
+        candidates = self.get_candidate_set(level)
+        if idx >= len(candidates):
+            raise RuntimeError("no exact candidate")
+        return candidates[idx]
+
+    def pick_next_nodes(self, level: int, how_many: int) -> List:
+        """Return not-yet-contacted candidates at `level`, own-index node
+        first, then up to how_many more by (post-removal) index — including
+        the reference's index-shift quirk after the first removal
+        (SanFerminHelper.java:123-157) — shuffled."""
+        candidate_set = list(self.get_candidate_set(level))
+        own_set = self.get_own_set(level)
+        try:
+            idx = own_set.index(self.n)
+        except ValueError:
+            raise RuntimeError("node not in its own set")
+        if len(own_set) < idx:
+            raise RuntimeError("bad own-set index")
+
+        new_list = []
+        used = self.used_nodes.get(level, set())
+        if idx not in used:
+            new_list.append(candidate_set[idx])
+            del candidate_set[idx]
+            used.add(idx)
+
+        count = 0
+        for i in range(len(candidate_set)):
+            if i in used:
+                continue
+            if count >= how_many:
+                break
+            used.add(i)
+            new_list.append(candidate_set[i])
+            count += 1
+
+        self.used_nodes[level] = used
+        self.rd.shuffle(new_list)
+        return new_list
