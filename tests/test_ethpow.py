@@ -1,0 +1,382 @@
+"""ETHPoW family tests (ported from ethpow/EthPoWTest.java): difficulty
+golden values, mining-duration convergence, fairness, uncles/rewards,
+selfish miners, agent decisions."""
+
+import random
+
+import pytest
+
+from wittgenstein_tpu.core.node import NodeBuilderWithRandomPosition
+from wittgenstein_tpu.core.registries import builder_name, RANDOM
+from wittgenstein_tpu.protocols.ethpow import (
+    Decision,
+    ETHAgentMiner,
+    ETHMiner,
+    ETHPoW,
+    ETHPoWParameters,
+    ETHSelfishMiner,
+    ETHSelfishMiner2,
+    POWBlock,
+    Reward,
+    try_miner,
+)
+from wittgenstein_tpu.oracle.blockchain import Block, SendBlock
+
+NL = "IC3NetworkLatency"
+BUILDER = builder_name(RANDOM, True, 1.0)
+
+
+@pytest.fixture()
+def ep():
+    Block.reset_block_ids()
+    p = ETHPoW(ETHPoWParameters(BUILDER, NL, 4, None, 0))
+    p.init()
+    return p
+
+
+@pytest.fixture()
+def gen():
+    return POWBlock.create_genesis()
+
+
+class TestDifficulty:
+    def test_difficulty_golden(self, gen):
+        """Real-chain difficulty values (EthPoWTest.java:32-69)."""
+        b1 = gen
+        b2 = POWBlock(None, b1, b1.proposal_time + 13000)
+        assert b2.difficulty == 1949482177664138
+        assert b2.total_difficulty == 10591884163387748525067
+
+        b3 = POWBlock(None, b2, b2.proposal_time + 7000)
+        assert b3.difficulty == 1950434207476428
+        assert b3.total_difficulty == 10591886113821956001495
+
+        b4 = POWBlock(None, b3, b3.proposal_time + 4000)
+        assert b4.difficulty == 1951386702147025
+        assert b4.total_difficulty == 10591888065208658148520
+
+        b5 = POWBlock(None, b4, b4.proposal_time + 39000)
+        assert b5.difficulty == 1948528359750282
+        assert b5.total_difficulty == 10591890013737017898802
+
+        b6 = POWBlock(None, b5, b5.proposal_time + 3000)
+        assert b6.difficulty == 1949479923831169
+        assert b6.total_difficulty == 10591891963216941729971
+
+        b7 = POWBlock(None, b6, b6.proposal_time + 15000)
+        assert b7.difficulty == 1949480058048897
+        assert b7.total_difficulty == 10591893912696999778868
+
+        u1 = POWBlock(None, b5, b5.proposal_time)
+        b8 = POWBlock(None, b7, b7.proposal_time + 11000, {u1})
+        assert b8.difficulty == 1949480192266625
+        assert b8.total_difficulty == 10591895862177192045493
+
+        b9 = POWBlock(None, b8, b8.proposal_time + 3000, {u1})
+        assert b9.difficulty == 1951384115734613
+        assert b9.total_difficulty == 10591897813561307780106
+
+    def test_find_hash(self, ep):
+        m0 = ep.network().get_node_by_id(0)
+        assert abs(m0.solve_in_10ms(1) - 1) < 0.00001
+
+    def test_initial_difficulty(self, ep, gen):
+        """Avg block generation ~13 s at real mainnet difficulty
+        (EthPoWTest.java:72-90; shorter horizon for Python speed)."""
+        nb = NodeBuilderWithRandomPosition()
+        m = ETHMiner(ep.network(), nb, 162 * 1024, gen)
+        avg_d = (
+            2031093808891300 + 2028116957207141 + 2032085740451229
+            + 2033078320257064 + 2032085956568356 + 2032085822350628
+        ) // 6
+        cur_proba = m.solve_in_10ms(avg_d)
+        rd = random.Random(42)
+        found = 0
+        time = 50_000_000
+        for _ in range(time // 10):
+            if rd.random() < cur_proba:
+                found += 1
+        avg = time / (1000.0 * found)
+        assert abs(avg - 13.0) < 1.0
+
+    def test_block_duration_convergence(self, ep, gen):
+        """(EthPoWTest.java:98-119; 2000 blocks instead of 10000)."""
+        nb = NodeBuilderWithRandomPosition()
+        m = ETHMiner(ep.network(), nb, 100 * 1024, gen)
+        cur = gen
+        cur_proba = m.solve_in_10ms(cur.difficulty)
+        rd = random.Random(7)
+        tot = 0
+        target = 2000
+        found = 0
+        t = gen.proposal_time
+        while cur.height - gen.height < target:
+            if rd.random() < cur_proba:
+                if cur.height > gen.height + target * 0.8:
+                    tot += t - cur.proposal_time
+                    found += 1
+                cur = POWBlock(m, cur, t)
+                cur_proba = m.solve_in_10ms(cur.difficulty)
+            t += 10
+        tot //= 1000
+        assert abs(tot / found - 13.0) < 1.0
+
+
+class TestMining:
+    def test_miners_fairness(self, ep):
+        """Two equal miners get similar rewards (EthPoWTest.java:122-130;
+        shorter horizon)."""
+        ep.network().run(2_000)
+        m0 = ep.network().get_node_by_id(0)
+        m1 = ep.network().get_node_by_id(1)
+        rs = m0.head.all_rewards()
+        c0 = rs.get(m0, 0.0)
+        c1 = rs.get(m1, 0.0)
+        assert abs(c0 - c1) < (c0 + c1) / 4
+
+    def test_uncles(self, gen):
+        """A competing block gets received by the network
+        (EthPoWTest.java:137-154; shorter horizon)."""
+        Block.reset_block_ids()
+        p = ETHPoW(ETHPoWParameters(BUILDER, NL, 5, None, 0))
+        p.init()
+        p.network().run(2000)
+        m = p.network().observer
+        timestamp = p.network().time
+        main = p.network().observer.blocks_received_by_height[gen.height + 2]
+        father = next(iter(main)).parent
+        uncle = POWBlock(m, father, timestamp)
+        p.network().send_all(SendBlock(uncle), m)
+        p.network().run(1000)
+        assert uncle in p.network().all_nodes[1].blocks_received_by_height[uncle.height]
+
+    def test_avg_difficulty(self, ep):
+        m1 = ep.network().get_node_by_id(1)
+        b1 = POWBlock(None, None, 1, height=1, diff=100)
+        assert b1.avg_difficulty(0) == 100
+        b2 = POWBlock(m1, b1, 1, height=2, diff=100)
+        assert b2.avg_difficulty(0) == 100
+        b3 = POWBlock(m1, b2, 1, height=3, diff=400)
+        assert b3.avg_difficulty(0) == 200
+        b4 = POWBlock(m1, b3, 1, height=4, diff=400)
+        assert b4.avg_difficulty(b3.height) == 400
+
+    def test_reward(self, ep, gen):
+        """(EthPoWTest.java:172-210)."""
+        m1 = ep.network().get_node_by_id(1)
+        m2 = ep.network().get_node_by_id(2)
+        m3 = ep.network().get_node_by_id(3)
+        b2 = POWBlock(m1, gen, gen.proposal_time + 13000)
+        r = b2.rewards()
+        assert len(r) == 1
+        assert abs(r[0].amount - 2.0) < 0.001
+        assert r[0].who is m1
+
+        u = POWBlock(m2, gen, gen.proposal_time + 13000)
+        ur = [1.75, 1.5, 1.25, 1.0, 0.75, 0.50, 0.25]
+        cur = b2
+        for p_i in range(7):
+            cur = POWBlock(m1, cur, cur.proposal_time + 13000, {u})
+            r = cur.rewards()
+            assert len(r) == 2
+            s = {}
+            Reward.sum_rewards(s, r)
+            assert len(s) == 2
+            assert abs(s[m1] - 2.0625) < 1e-7
+            assert abs(s[m2] - ur[p_i]) < 1e-7
+
+        cur = POWBlock(m1, b2, b2.proposal_time + 13000)
+        u2 = POWBlock(m3, cur, cur.proposal_time + 13000)
+        cur = POWBlock(m1, cur, cur.proposal_time + 13000)
+        cur = POWBlock(m1, cur, cur.proposal_time + 13000, {u, u2})
+        r = cur.rewards()
+        assert len(r) == 3
+        s = {}
+        Reward.sum_rewards(s, r)
+        assert len(s) == 3
+        assert abs(s[m1] - (2.0 + 0.0625 * 2)) < 1e-7
+        assert abs(s[m2] - 1.25) < 1e-7
+        assert abs(s[m3] - 1.75) < 1e-7
+
+    def test_uncle_sort(self, ep, gen):
+        """(EthPoWTest.java:212-234)."""
+        import functools
+
+        m0 = ep.network().get_node_by_id(0)
+        m1 = ep.network().get_node_by_id(1)
+        b1 = POWBlock(m0, gen, gen.proposal_time + 1)
+        b2 = POWBlock(m1, gen, gen.proposal_time + 1)
+        us = [b1, b2]
+        us.sort(key=functools.cmp_to_key(m0._uncle_cmp))
+        assert us[0].producer is m0
+        us.sort(key=functools.cmp_to_key(m1._uncle_cmp))
+        assert us[0].producer is m1
+        assert m0._uncle_cmp(b1, b2) < 0
+        assert m1._uncle_cmp(b1, b2) > 0
+        b3 = POWBlock(m0, gen, gen.proposal_time + 1)
+        b4 = POWBlock(m0, b1, gen.proposal_time + 1)
+        assert m0._uncle_cmp(b3, b4) > 0
+        assert m1._uncle_cmp(b3, b4) < 0
+
+    def test_uncle_selection(self, ep, gen):
+        """(EthPoWTest.java:236-281)."""
+        m0 = ep.network().get_node_by_id(0)
+        m1 = ep.network().get_node_by_id(1)
+        m2 = ep.network().get_node_by_id(2)
+        m3 = ep.network().get_node_by_id(3)
+        b1 = POWBlock(m0, gen, gen.proposal_time + 1)
+        b2 = POWBlock(m0, b1, b1.proposal_time + 1)
+        b3 = POWBlock(m0, b2, b2.proposal_time + 1)
+        bs = []
+        for b in (b1, b2, b3):
+            bs.append(b)
+            bs.append(POWBlock(m1, b, b.proposal_time + 1))
+            bs.append(POWBlock(m2, b, b.proposal_time + 1))
+            bs.append(POWBlock(m3, b, b.proposal_time + 1))
+        for b in bs:
+            for n in ep.network().all_nodes:
+                n.on_block(b)
+        assert len(m0.possible_uncles(b1)) == 0
+        assert len(m1.possible_uncles(b1)) == 0
+        us = m0.possible_uncles(b2)
+        assert len(us) == 3
+        assert b1 not in us and b2 not in us
+        us = m1.possible_uncles(b2)
+        assert len(us) == 3
+        us = m0.possible_uncles(b3)
+        assert len(us) == 6
+        assert b1 not in us and b2 not in us
+        us = m1.possible_uncles(b3)
+        assert len(us) == 6
+
+    def test_mining_with_uncle(self, ep, gen):
+        """(EthPoWTest.java:283-326)."""
+        m0 = ep.network().get_node_by_id(0)
+        m1 = ep.network().get_node_by_id(1)
+        m2 = ep.network().get_node_by_id(2)
+        m3 = ep.network().get_node_by_id(3)
+        b1 = POWBlock(m0, gen, gen.proposal_time + 1)
+        b2 = POWBlock(m0, b1, b1.proposal_time + 1)
+        b3 = POWBlock(m0, b2, b2.proposal_time + 1)
+        b4 = POWBlock(m0, b3, b3.proposal_time + 1)
+        for b in (b1, b2, b3):
+            m0.on_block(b)
+            m0.on_block(POWBlock(m1, b, b.proposal_time + 1))
+            m0.on_block(POWBlock(m2, b, b.proposal_time + 1))
+            m0.on_block(POWBlock(m3, b, b.proposal_time + 1))
+        m0.on_block(b4)
+
+        ep.network().time = b4.proposal_time + 1
+        m0.lucky_mine()
+        assert len(m0.head.uncles) == 2  # father is b1 for both
+        assert m0.head.uncles[0].height == b2.height
+
+        ep.network().time += 1
+        m0.lucky_mine()
+        assert len(m0.head.uncles) == 2  # fathers: b1 and b2
+
+        ep.network().time += 1
+        m0.lucky_mine()
+        assert len(m0.head.uncles) == 2  # father is b2 for both
+        assert m0.head.uncles[0].height == b3.height
+
+        ep.network().time += 1
+        m0.lucky_mine()
+        assert len(m0.head.uncles) == 2  # father is b3 for both
+        assert m0.head.uncles[0].height == b3.height + 1
+        assert m0.head.uncles[1].height == b3.height + 1
+
+        ep.network().time += 1
+        m0.lucky_mine()
+        assert len(m0.head.uncles) == 1  # father is b3
+        assert m0.head.uncles[0].height == b3.height + 1
+
+        ep.network().time += 1
+        m0.lucky_mine()
+        assert len(m0.head.uncles) == 0
+
+
+class _EmptyDecision(Decision):
+    def __init__(self, gen, reward_at_height):
+        super().__init__(1, gen.height + 1 + reward_at_height)
+        self.p = reward_at_height
+
+    def for_csv(self):
+        return str(self.p)
+
+
+class TestAgent:
+    def test_decision_sorting(self, ep, gen, tmp_path, monkeypatch):
+        monkeypatch.setattr(ETHAgentMiner, "DATA_FILE", str(tmp_path / "decisions.csv"))
+        nb = NodeBuilderWithRandomPosition()
+        n = ETHAgentMiner(ep.network(), nb, 1, gen)
+        for h in (100, 50, 125, 25, 120, 75, 35, 1):
+            n.add_decision(_EmptyDecision(gen, h))
+        assert len(n.decisions) == 8
+        cur = 0
+        for f in n.decisions:
+            assert f.reward_at_height >= cur
+            cur = f.reward_at_height
+        n.close()
+
+
+class _DelayedMiner(ETHAgentMiner):
+    def extra_send_delay(self, mined):
+        duration = self._network.time - mined.proposal_time
+        depth = self.depth(mined)
+        delay = self._network.rd.next_int(20) * 500
+        self.add_decision(
+            _ExtraSendDelayDecision(mined.height, depth, mined.height + 10, duration, delay)
+        )
+        return delay
+
+
+class _ExtraSendDelayDecision(Decision):
+    def __init__(self, taken_at_height, own_mining_depth, reward_at_height, duration, delay):
+        super().__init__(taken_at_height, reward_at_height)
+        self.mining_duration_ms = duration
+        self.own_mining_depth = own_mining_depth
+        self.delay = delay
+
+    def for_csv(self):
+        return f"{self.mining_duration_ms},{self.own_mining_depth},{self.delay}"
+
+
+def _test_bad_miner(miner, tmp_path, monkeypatch):
+    """(EthPoWTest.java:406-414; 1 run x 1 hour for Python speed)."""
+    monkeypatch.setattr(ETHAgentMiner, "DATA_FILE", str(tmp_path / "decisions.csv"))
+    Block.reset_block_ids()
+    nl_name = "NetworkUniformLatency(2000)"
+    bdl_name = builder_name(RANDOM, True, 0)
+    try_miner(bdl_name, nl_name, miner, [0.50], 1, 1, verbose=False)
+
+
+class TestBadMiners:
+    def test_selfish_miner(self, tmp_path, monkeypatch):
+        _test_bad_miner(ETHSelfishMiner, tmp_path, monkeypatch)
+
+    def test_selfish_miner2(self, tmp_path, monkeypatch):
+        _test_bad_miner(ETHSelfishMiner2, tmp_path, monkeypatch)
+
+    def test_standard_miner(self, tmp_path, monkeypatch):
+        _test_bad_miner(ETHMiner, tmp_path, monkeypatch)
+
+    def test_delayed_miner(self, tmp_path, monkeypatch):
+        from wittgenstein_tpu.protocols import ethpow as ethpow_mod
+
+        monkeypatch.setitem(ethpow_mod.BYZ_MINER_CLASSES, "_DelayedMiner", _DelayedMiner)
+        _test_bad_miner(_DelayedMiner, tmp_path, monkeypatch)
+
+
+class TestAgentBridge:
+    def test_go_next_step(self, monkeypatch, tmp_path):
+        """The pyjnius-replacement API: create → init → goNextStep
+        (ETHMinerAgent.java:26-36 recipe)."""
+        from wittgenstein_tpu.protocols.ethpow import create_agent
+
+        Block.reset_block_ids()
+        p = create_agent(0.25, rd_seed=1)
+        p.init()
+        step = p.get_byz_node().go_next_step()
+        assert step in (1, 2, 3)
+        assert p.get_byz_node().head.height >= p.genesis.height

@@ -9,6 +9,7 @@ wittgenstein_tpu.core.params.protocol_registry (the API-discovery contract).
 
 from . import (  # noqa: F401
     enr_gossiping,
+    ethpow,
     gsf,
     handel,
     optimistic_p2p_signature,
@@ -24,6 +25,7 @@ from . import (  # noqa: F401
 
 __all__ = [
     "enr_gossiping",
+    "ethpow",
     "gsf",
     "handel",
     "optimistic_p2p_signature",

@@ -1,0 +1,808 @@
+"""Ethereum proof-of-work family: miners with Bernoulli-per-10ms mining,
+EIP-standard difficulty adjustment, uncles/rewards, selfish-mining attacks
+(Eyal-Sirer), and a stepwise RL-agent miner.
+
+Reference semantics: protocols/ethpow/ETHPoW.java (POWBlock difficulty
+:284-296, rewards :182-257, uncle check :260-270), ETHMiner.java (mining
+loop :118-148, uncle selection :66-115, strategy hooks :25-51),
+ETHSelfishMiner.java / ETHSelfishMiner2.java (algorithm 1 of the
+selfish-mining paper), ETHMinerAgent.java (stepwise goNextStep bridge —
+callable directly from Python here, no pyjnius needed), ETHAgentMiner.java
+(decision CSV logger).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Dict, List, Optional, Set
+
+from ..core.node import NodeBuilder
+from ..core.params import WParameters, register_protocol
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..oracle.blockchain import Block, BlockChainNetwork, BlockChainNode, SendBlock
+from ..oracle.network import Protocol
+
+
+@dataclasses.dataclass
+class ETHPoWParameters(WParameters):
+    node_builder_name: Optional[str] = None
+    network_latency_name: Optional[str] = None
+    number_of_miners: int = 1
+    byz_class_name: Optional[str] = None
+    byz_mining_ratio: float = 0
+
+    def __post_init__(self):
+        if not self.byz_class_name:
+            self.byz_class_name = None
+            self.byz_mining_ratio = 0
+
+
+class Reward:
+    __slots__ = ("who", "amount")
+
+    def __init__(self, who: "ETHMiner", amount: float):
+        self.who = who
+        self.amount = amount
+
+    @staticmethod
+    def sum_rewards(sum_: Dict["ETHMiner", float], rewards: List["Reward"]) -> None:
+        for r in rewards:
+            sum_[r.who] = sum_.get(r.who, 0.0) + r.amount
+
+
+class POWBlock(Block):
+    """Block with Constantinople difficulty and uncle rewards
+    (ETHPoW.java:118-297)."""
+
+    __slots__ = ("difficulty", "total_difficulty", "transactions", "uncles")
+
+    def __init__(
+        self,
+        producer: Optional["ETHMiner"],
+        father: Optional["POWBlock"],
+        time: int,
+        uncles: Optional[Set["POWBlock"]] = None,
+        height: Optional[int] = None,
+        diff: Optional[int] = None,
+        genesis: bool = False,
+    ):
+        self.uncles: List[POWBlock] = []
+        self.transactions: List = []
+        if genesis:
+            # starts at mainnet block 7951081 (ETHPoW.java:158-164)
+            super().__init__(height=7951081, genesis=True)
+            self.difficulty = 1949482043446410
+            self.total_difficulty = 10591882213905570860929
+            return
+        if diff is not None:
+            # test constructor (ETHPoW.java:167-175)
+            super().__init__(producer, height, father, True, time)
+            self.difficulty = diff
+            self.total_difficulty = (
+                father.total_difficulty + diff if father is not None else diff
+            )
+            return
+        super().__init__(producer, father.height + 1, father, True, time)
+        if uncles:
+            if len(uncles) > 2:
+                raise ValueError(f"Can't have more than 2 uncles: {self}, {len(uncles)}")
+            for u in uncles:
+                if not self.is_possible_uncle(u):
+                    raise ValueError(f"{u} can't be an uncle of {self}")
+                self.uncles.append(u)
+        self.difficulty = self.calculate_difficulty(father, time)
+        self.total_difficulty = father.total_difficulty + self.difficulty
+
+    def on_calculate_difficulty(self, all_: int, father, diff: int, bomb: int) -> int:
+        return all_
+
+    def rewards(self) -> List[Reward]:
+        """Block + uncle rewards (ETHPoW.java:182-197)."""
+        rwd = 2.0
+        if not self.uncles:
+            return [Reward(self.producer, rwd)]
+        res = []
+        p_r = rwd
+        for u in self.uncles:
+            u_r = (rwd * (u.height + 8 - self.height)) / 8
+            p_r += rwd / 32
+            res.append(Reward(u.producer, u_r))
+        res.append(Reward(self.producer, p_r))
+        return res
+
+    def all_rewards(self, until_height: int = 0) -> Dict["ETHMiner", float]:
+        res: Dict[ETHMiner, float] = {}
+        cur = self
+        while cur.producer is not None and cur.height >= until_height - 1:
+            Reward.sum_rewards(res, cur.rewards())
+            cur = cur.parent
+        return res
+
+    def all_rewards_by_id(self, sum_: Dict[int, float], until_height: int) -> None:
+        cur = self
+        while cur.producer is not None and cur.height > until_height:
+            for r in cur.rewards():
+                sum_[r.who.node_id] = sum_.get(r.who.node_id, 0.0) + r.amount
+            cur = cur.parent
+
+    def avg_difficulty(self, until_height: int) -> int:
+        cur = self
+        while cur.producer is not None and cur.height > until_height:
+            cur = cur.parent
+        if cur is self:
+            return cur.difficulty
+        diff = self.total_difficulty - cur.total_difficulty + cur.difficulty
+        blocks = 1 + self.height - cur.height
+        return diff // blocks
+
+    def uncle_rate(self, until_height: int) -> float:
+        uncles = 0.0
+        cur = self
+        first = None
+        while cur.producer is not None and cur.height > until_height:
+            uncles += len(cur.uncles)
+            first = cur
+            cur = cur.parent
+        return 0.0 if first is None else uncles / (uncles + self.height - first.height)
+
+    def is_possible_uncle(self, b: "POWBlock") -> bool:
+        """(ETHPoW.java:260-270)."""
+        if b.height >= self.height or self.height - b.height > 7:
+            return False
+        cur = self
+        while cur is not None and cur.height > b.height:
+            cur = cur.parent
+        return cur is not None and cur.parent is b.parent
+
+    @staticmethod
+    def create_genesis() -> "POWBlock":
+        return POWBlock(None, None, 0, genesis=True)
+
+    def calculate_difficulty(self, father: "POWBlock", ts: int) -> int:
+        """Constantinople difficulty incl. the EIP-100/EIP-1234 bomb
+        (ETHPoW.java:284-296); all divisions are Java long divisions on
+        positive operands."""
+        gap = (ts - father.proposal_time) // 9000
+        y = 1 if not father.uncles else 2
+        ugap = max(-99, y - gap)
+        diff = (father.difficulty // 2048) * ugap
+        periods = (father.height - 4_999_999) // 100_000
+        bomb = 2 ** (periods - 2) if periods > 1 else diff
+        all_ = father.difficulty + diff + bomb
+        return self.on_calculate_difficulty(all_, father, diff, bomb)
+
+
+def pow_block_cmp(o1: POWBlock, o2: POWBlock) -> int:
+    """(ETHPoW.java:299-310)."""
+    if o1 is o2:
+        return 0
+    if not o2.valid:
+        return 1
+    if not o1.valid:
+        return -1
+    return (o1.total_difficulty > o2.total_difficulty) - (
+        o1.total_difficulty < o2.total_difficulty
+    )
+
+
+class ETHPoWNode(BlockChainNode):
+    __slots__ = ("_network",)
+
+    def __init__(self, network: BlockChainNetwork, nb: NodeBuilder, genesis: POWBlock):
+        super().__init__(network.rd, nb, False, genesis)
+        self._network = network
+
+    def best(self, cur: POWBlock, alt: POWBlock) -> POWBlock:
+        """Fork choice by total difficulty; prefer own block on ties
+        (ETHPoW.java:337-348)."""
+        if alt is None:
+            return cur
+        if cur is None:
+            return alt
+        res = pow_block_cmp(cur, alt)
+        if res == 0:
+            return alt if alt.producer is self else cur
+        return cur if res > 0 else alt
+
+
+class ETHMiner(ETHPoWNode):
+    """Honest miner with strategy hooks (ETHMiner.java)."""
+
+    __slots__ = ("hash_power_ghs", "in_mining", "mined_to_send", "threshold")
+
+    def __init__(self, network, nb, hash_power_ghs: int, genesis: POWBlock):
+        super().__init__(network, nb, genesis)
+        self.hash_power_ghs = hash_power_ghs
+        self.in_mining: Optional[POWBlock] = None
+        self.mined_to_send: Set[POWBlock] = set()
+        self.threshold = 0.0
+
+    # -- strategy hooks (ETHMiner.java:25-51) ------------------------------
+    def include_uncle(self, uncle: POWBlock) -> bool:
+        return True
+
+    def send_mined_block(self, mined: POWBlock) -> bool:
+        return True
+
+    def extra_send_delay(self, mined: POWBlock) -> int:
+        return 0
+
+    def switch_mining(self, rcv: POWBlock) -> bool:
+        return True
+
+    def on_new_head(self, old_head: POWBlock, new_head: POWBlock) -> None:
+        pass
+
+    def on_mined_block(self, mined: POWBlock) -> None:
+        pass
+
+    def on_received_block(self, rcv: POWBlock) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    # ----------------------------------------------------------------------
+    def depth(self, b: Optional[POWBlock]) -> int:
+        """Blocks we mined in a row from 'b' (ETHMiner.java:54-63)."""
+        res = 0
+        while b is not None and b.producer is self:
+            res += 1
+            b = b.parent
+        return res
+
+    def possible_uncles(self, father: POWBlock) -> List[POWBlock]:
+        """(ETHMiner.java:66-90)."""
+        res: List[POWBlock] = []
+        included: Set[POWBlock] = set()
+        b = father
+        h = 0
+        while b is not None and h < 8:
+            included.add(b)
+            included.update(b.uncles)
+            b = b.parent
+            h += 1
+        for h in range(father.height, father.height - 7, -1):
+            # block-id order: the reference iterates a HashSet (arbitrary
+            # but fixed per JVM run); iterating by id keeps our runs
+            # seed-reproducible, which the Java version doesn't guarantee
+            rcv = sorted(self.blocks_received_by_height.get(h, set()), key=lambda b: b.id)
+            for u in rcv:
+                if (
+                    u not in included
+                    and (u.parent is father.parent or father.is_possible_uncle(u))
+                    and self.include_uncle(u)
+                ):
+                    res.append(u)
+        res.sort(key=functools.cmp_to_key(self._uncle_cmp))
+        return res
+
+    def _uncle_cmp(self, o1: POWBlock, o2: POWBlock) -> int:
+        """Own uncles first (higher height first among ours); otherwise
+        smallest height first (ETHMiner.java:98-115)."""
+        if o1.producer is self:
+            if o2.producer is not o1.producer:
+                return -1
+            return (o2.height > o1.height) - (o2.height < o1.height)
+        if o2.producer is self:
+            return 1
+        return (o1.height > o2.height) - (o1.height < o2.height)
+
+    def mine10ms(self) -> bool:
+        """One Bernoulli trial per 10 ms period (ETHMiner.java:118-129)."""
+        if self.in_mining is None:
+            self.start_new_mining(self.head)
+        assert self.in_mining is not None
+        if self._network.rd.next_double() < self.threshold:
+            self._on_found_new_block(self.in_mining)
+            return True
+        return False
+
+    def start_new_mining(self, father: POWBlock) -> None:
+        us = self.possible_uncles(father)
+        uss = set(us[:2]) if us else set()
+        self.in_mining = POWBlock(self, father, self._network.time, uss)
+        self.threshold = self.solve_in_10ms(self.in_mining.difficulty)
+
+    def lucky_mine(self) -> None:
+        """Tests: force a successful mining (ETHMiner.java:143-148)."""
+        if not self.mine10ms():
+            self.threshold = 10
+            self.mine10ms()
+
+    def send_block(self, mined: POWBlock) -> None:
+        if mined.producer is not self:
+            raise ValueError(f"logic error: you're not the producer of this block{mined}")
+        send_time = self._network.time + 1 + self.extra_send_delay(mined)
+        if send_time < 1:
+            raise ValueError(f"extraSendDelay({mined}) sent a negative time")
+        self._network.send_all(SendBlock(mined), self, send_time)
+        self.mined_to_send.discard(mined)
+
+    def send_all_mined(self) -> None:
+        # NOTE: invokes the boolean *hook* send_mined_block (not send_block),
+        # exactly like the reference (ETHMiner.java:165-171) — for miners
+        # whose hook returns False (selfish/agent) the withheld blocks are
+        # dropped, not broadcast.  Kept verbatim: it is the reference's
+        # observable behavior, quirky as it is.
+        all_ = list(self.mined_to_send)
+        self.mined_to_send.clear()
+        for b in all_:
+            self.send_mined_block(b)
+
+    def _on_found_new_block(self, mined: POWBlock) -> None:
+        old_head = self.head
+        self.in_mining = None
+        if self.send_mined_block(mined):
+            self.send_block(mined)
+        else:
+            self.mined_to_send.add(mined)
+        if not BlockChainNode.on_block(self, mined):
+            raise RuntimeError(f"invalid mined block:{mined}")
+        if mined is self.head:
+            self.on_new_head(old_head, mined)
+        self.on_mined_block(mined)
+
+    def get_mined_to_send(self) -> int:
+        return len(self.mined_to_send)
+
+    def on_block(self, b: POWBlock) -> bool:
+        """(ETHMiner.java:197-222)."""
+        old_head = self.head
+        if not super().on_block(b):
+            return False
+        if b is self.head:
+            self.on_new_head(old_head, b)
+            # someone sent us a new head: switch our mining to it
+            if self.switch_mining(b):
+                self.in_mining = None
+        elif self.in_mining is not None:
+            # maybe 'b' is an uncle candidate for the block we're mining
+            if self.in_mining.is_possible_uncle(b):
+                if self.switch_mining(b):
+                    self.in_mining = None
+        self.on_received_block(b)
+        return True
+
+    def solve_in_10ms(self, difficulty: int) -> float:
+        """P(find a hash in 10 ms) for this hash power (ETHMiner.java:225-231)."""
+        hp_t_ms = (self.hash_power_ghs * 1024.0 * 1024 * 1024) / 100.0
+        single = 1.0 / difficulty
+        no_success = math.pow(1.0 - single, hp_t_ms)
+        return 1 - no_success
+
+
+class ETHSelfishMiner(ETHMiner):
+    """Eyal-Sirer selfish mining, algorithm 1 (ETHSelfishMiner.java)."""
+
+    __slots__ = ("private_miner_block", "other_miners_head")
+
+    def __init__(self, network, nb, hash_power: int, genesis: POWBlock):
+        super().__init__(network, nb, hash_power, genesis)
+        self.private_miner_block: Optional[POWBlock] = None
+        self.other_miners_head = genesis
+
+    def _private_height(self) -> int:
+        return 0 if self.private_miner_block is None else self.private_miner_block.height
+
+    def send_mined_block(self, mined: POWBlock) -> bool:
+        return False
+
+    def include_uncle(self, uncle: POWBlock) -> bool:
+        return True
+
+    def on_mined_block(self, mined: POWBlock) -> None:
+        if self.private_miner_block is not None and mined.height <= self.private_miner_block.height:
+            raise RuntimeError(
+                f"privateMinerBlock={self.private_miner_block}, mined={mined}"
+            )
+        self.private_miner_block = mined
+        delta_p = self._private_height() - (self.other_miners_head.height - 1)
+        if delta_p == 0 and self.depth(self.private_miner_block) == 2:
+            self.other_miners_head = self.best(self.other_miners_head, self.private_miner_block)
+            self.send_all_mined()
+        self.start_new_mining(self.private_miner_block)
+
+    def on_received_block(self, rcv: POWBlock) -> None:
+        """(ETHSelfishMiner.java:56-115)."""
+        self.other_miners_head = self.best(self.other_miners_head, rcv)
+        if self.other_miners_head is not rcv:
+            return
+        delta_p = self._private_height() - (self.other_miners_head.height - 1)
+        if delta_p <= 0:
+            # they won: we move to their chain
+            self.send_all_mined()
+            self.start_new_mining(self.head)
+        else:
+            if delta_p == 1 or delta_p == 2:
+                to_send = self.private_miner_block
+            else:
+                # far ahead: try to win by sending a competing block
+                to_send = self.private_miner_block
+                while to_send.parent in self.mined_to_send and to_send.height > rcv.height:
+                    to_send = to_send.parent
+                    assert to_send is not None
+                if to_send.height != rcv.height:
+                    f = to_send
+                    while f.height != rcv.height:
+                        f = f.parent
+                    if f.total_difficulty < rcv.total_difficulty:
+                        return
+            while (
+                to_send is not None
+                and to_send.producer is self
+                and to_send in self.mined_to_send
+            ):
+                self.other_miners_head = self.best(self.other_miners_head, to_send)
+                self.send_block(to_send)
+                to_send = to_send.parent
+
+
+class ETHSelfishMiner2(ETHMiner):
+    """Selfish-mining variant keyed on total difficulty (ETHSelfishMiner2.java)."""
+
+    __slots__ = ("private_miner_block", "other_miners_head")
+
+    def __init__(self, network, nb, hash_power: int, genesis: POWBlock):
+        super().__init__(network, nb, hash_power, genesis)
+        self.private_miner_block: Optional[POWBlock] = None
+        self.other_miners_head = genesis
+
+    def _private_height(self) -> int:
+        return 0 if self.private_miner_block is None else self.private_miner_block.height
+
+    def send_mined_block(self, mined: POWBlock) -> bool:
+        return False
+
+    def include_uncle(self, uncle: POWBlock) -> bool:
+        return True
+
+    def on_mined_block(self, mined: POWBlock) -> None:
+        if self.private_miner_block is not None and mined.height <= self.private_miner_block.height:
+            raise RuntimeError(
+                f"privateMinerBlock={self.private_miner_block}, mined={mined}"
+            )
+        self.private_miner_block = mined
+        delta_p = self._private_height() - (self.other_miners_head.height - 1)
+        if delta_p == 0 and self.depth(self.private_miner_block) == 2:
+            self.other_miners_head = self.best(self.other_miners_head, self.private_miner_block)
+            self.send_all_mined()
+        self.start_new_mining(self.private_miner_block)
+
+    def on_received_block(self, rcv: POWBlock) -> None:
+        """(ETHSelfishMiner2.java:55-81)."""
+        self.other_miners_head = self.best(self.other_miners_head, rcv)
+        if self.other_miners_head is not rcv:
+            return
+        if self.head is rcv:
+            self.send_all_mined()
+            self.start_new_mining(self.head)
+        else:
+            to_send = self.private_miner_block
+            while (
+                to_send.parent is not None
+                and to_send.height >= rcv.height
+                and to_send.parent.total_difficulty > rcv.total_difficulty
+            ):
+                to_send = to_send.parent
+            while (
+                to_send is not None
+                and to_send.producer is self
+                and to_send in self.mined_to_send
+            ):
+                self.other_miners_head = self.best(self.other_miners_head, to_send)
+                self.send_block(to_send)
+                to_send = to_send.parent
+
+
+ON_MINED_BLOCK = 1
+ON_OTHER_NEW_HEAD = 2
+ON_OTHER_PRIVATE_HEAD = 3
+
+
+class ETHMinerAgent(ETHMiner):
+    """Stepwise miner for RL agents: `go_next_step()` runs the simulation
+    until a decision is needed (ETHMinerAgent.java:38-225).  The reference
+    embeds the JVM via pyjnius; here the same API is plain Python."""
+
+    __slots__ = ("private_miner_block", "other_miners_head", "decision_needed")
+
+    def __init__(self, network, nb, hash_power_ghs: int, genesis: POWBlock):
+        super().__init__(network, nb, hash_power_ghs, genesis)
+        self.private_miner_block: Optional[POWBlock] = None
+        self.other_miners_head = genesis
+        self.decision_needed = 0
+
+    def send_mined_block(self, mined: POWBlock) -> bool:
+        return False
+
+    def send_mined_blocks(self, how_many: int) -> None:
+        """(ETHMinerAgent.java:68-88)."""
+        if self.decision_needed == 0:
+            print(
+                f"no action needed: howMany={how_many}, advance={self.get_advance()}, "
+                f"secretAdvance={self.get_secret_advance()}"
+            )
+        while how_many > 0 and self.mined_to_send:
+            self.action_send_oldest_block_mined()
+            how_many -= 1
+        if how_many == 0 and self.in_mining is not None and self.private_miner_block is not None:
+            self.start_new_mining(self.head)
+        if not self.mined_to_send:
+            self.private_miner_block = None
+
+    def go_next_step(self) -> int:
+        """Run the network until the agent needs to decide
+        (ETHMinerAgent.java:90-100)."""
+        self.decision_needed = 0
+        while self.decision_needed == 0:
+            self._network.run_ms(1)
+            if self.decision_needed > ON_MINED_BLOCK and not self.mined_to_send:
+                self.decision_needed = 0
+        return self.decision_needed
+
+    def get_secret_advance(self) -> int:
+        priv = 0 if self.private_miner_block is None else self.private_miner_block.height
+        return max(priv - self.other_miners_head.height, 0)
+
+    def get_advance(self) -> int:
+        cur = self.head
+        score = 0
+        while cur.producer is self:
+            cur = cur.parent
+            score += 1
+        return score
+
+    def get_lag(self) -> int:
+        cur = self.head
+        score = 0
+        while cur.producer is not self:
+            cur = cur.parent
+            score += 1
+        return score
+
+    def get_reward(self, last_blocks_count: Optional[int] = None) -> float:
+        if last_blocks_count is None:
+            return self.head.all_rewards().get(self, 0.0)
+        return self.head.all_rewards(self.head.height - last_blocks_count).get(self, 0.0)
+
+    def get_reward_ratio(self) -> float:
+        ar = self.head.all_rewards()
+        all_ = sum(ar.values())
+        me = ar.get(self, 0.0)
+        return me / all_ if me > 0 else 0
+
+    def i_am_ahead(self) -> bool:
+        return self.head.producer is self
+
+    def count_my_blocks(self) -> int:
+        count = 0
+        cur = self.head
+        while cur is not None:
+            if cur.producer is self:
+                count += 1
+            cur = cur.parent
+        return count
+
+    def on_new_head(self, old_head: POWBlock, new_head: POWBlock) -> None:
+        self.start_new_mining(new_head)
+
+    def on_received_block(self, rcv: POWBlock) -> None:
+        """(ETHMinerAgent.java:187-204)."""
+        self.other_miners_head = self.best(self.other_miners_head, rcv)
+        if self.head is rcv:
+            self.decision_needed = ON_OTHER_NEW_HEAD
+        elif self.other_miners_head is rcv:
+            self.decision_needed = ON_OTHER_PRIVATE_HEAD
+        cont = True
+        while cont and self.mined_to_send:
+            youngest = min(self.mined_to_send, key=lambda o: o.height)
+            if youngest.height <= self.other_miners_head.height:
+                self.send_mined_blocks(1)
+            else:
+                cont = False
+
+    def on_mined_block(self, mined: POWBlock) -> None:
+        self.decision_needed = ON_MINED_BLOCK
+        if self.private_miner_block is not None and mined.height <= self.private_miner_block.height:
+            raise RuntimeError(
+                f"privateMinerBlock={self.private_miner_block}, mined={mined}"
+            )
+        self.private_miner_block = mined
+
+    def action_send_oldest_block_mined(self) -> None:
+        oldest = min(self.mined_to_send, key=lambda o: o.proposal_time)
+        if oldest.height > self.other_miners_head.height:
+            self.other_miners_head = oldest
+        self.send_block(oldest)
+
+
+class Decision:
+    """Base for agent decisions evaluated later (ETHPoW.java:352-374)."""
+
+    def __init__(self, taken_at_height: int, reward_at_height: int):
+        self.taken_at_height = taken_at_height
+        self.reward_at_height = reward_at_height
+
+    def for_csv(self) -> str:
+        raise NotImplementedError
+
+    def __str__(self) -> str:
+        return self.for_csv()
+
+    def reward(self, current_head: POWBlock, miner: "ETHAgentMiner") -> float:
+        return current_head.all_rewards(self.taken_at_height).get(miner, 0.0)
+
+
+class ETHAgentMiner(ETHMiner):
+    """Miner that logs decisions + delayed rewards to a CSV
+    (ETHAgentMiner.java)."""
+
+    DATA_FILE = "decisions.csv"
+
+    __slots__ = ("decisions", "_decision_output")
+
+    def __init__(self, network, nb, hash_power: int, genesis: POWBlock):
+        super().__init__(network, nb, hash_power, genesis)
+        self.decisions: List[Decision] = []
+        self._decision_output = open(self.DATA_FILE, "a")
+
+    def add_decision(self, d: Decision) -> None:
+        """Insert keeping the list sorted by rewardAtHeight
+        (ETHAgentMiner.java:36-53)."""
+        if d.reward_at_height <= self.head.height:
+            raise ValueError(f"Can't calculate a reward for {d}, head={self.head}")
+        if not self.decisions or self.decisions[-1].reward_at_height <= d.reward_at_height:
+            self.decisions.append(d)
+        else:
+            i = len(self.decisions)
+            while i > 0 and self.decisions[i - 1].reward_at_height > d.reward_at_height:
+                i -= 1
+            self.decisions.insert(i, d)
+
+    def on_new_head(self, old_head: POWBlock, new_head: POWBlock) -> None:
+        while self.decisions and self.decisions[0].reward_at_height <= new_head.height:
+            cur = self.decisions.pop(0)
+            reward = cur.reward(new_head, self)
+            self._decision_output.write(f"{cur.for_csv()},{reward}\n")
+
+    def close(self) -> None:
+        self._decision_output.close()
+
+
+# Explicit class map replacing the reference's reflection lookup
+# (ETHPoW.java:78-87); keyed by simple name, Java FQNs also accepted.
+BYZ_MINER_CLASSES = {
+    "ETHMiner": ETHMiner,
+    "ETHSelfishMiner": ETHSelfishMiner,
+    "ETHSelfishMiner2": ETHSelfishMiner2,
+    "ETHMinerAgent": ETHMinerAgent,
+    "ETHAgentMiner": ETHAgentMiner,
+}
+
+
+def resolve_miner_class(name) -> type:
+    if isinstance(name, type):
+        return name
+    key = name.rsplit(".", 1)[-1]
+    cls = BYZ_MINER_CLASSES.get(key)
+    if cls is None:
+        raise ValueError(f"unknown miner class {name!r}")
+    return cls
+
+
+@register_protocol("ETHPoW", ETHPoWParameters)
+class ETHPoW(Protocol):
+    def __init__(self, params: ETHPoWParameters):
+        self.params = params
+        self._network: BlockChainNetwork = BlockChainNetwork()
+        self.nb = registry_node_builders.get_by_name(params.node_builder_name)
+        self._network.set_network_latency(
+            registry_network_latencies.get_by_name(params.network_latency_name)
+        )
+        self.genesis = POWBlock.create_genesis()
+
+    def network(self) -> BlockChainNetwork:
+        return self._network
+
+    def copy(self) -> "ETHPoW":
+        return ETHPoW(self.params)
+
+    def get_byzantine_node(self) -> ETHMiner:
+        if self.params.byz_class_name is None:
+            raise ValueError("no byzantine node in this network")
+        return self._network.get_node_by_id(1)  # bad node is always at pos 1
+
+    def init(self) -> None:
+        """(ETHPoW.java:70-98)."""
+        p = self.params
+        total_hash_power = 200 * 1024
+        byz_hash_power = int(total_hash_power * p.byz_mining_ratio)
+        honest_miners = p.number_of_miners if byz_hash_power == 0 else p.number_of_miners - 1
+        honest_hash_power = (total_hash_power - byz_hash_power) // honest_miners
+        for i in range(p.number_of_miners):
+            if i == 1 and p.byz_class_name:
+                cls = resolve_miner_class(p.byz_class_name)
+                cur = cls(self._network, self.nb, byz_hash_power, self.genesis)
+            else:
+                cur = ETHMiner(self._network, self.nb, honest_hash_power, self.genesis)
+            if i == 0:
+                self._network.add_observer(cur)
+            else:
+                self._network.add_node(cur)
+            self._network.register_periodic_task(cur.mine10ms, 1, 10, cur)
+
+
+class ETHPoWWithAgent(ETHPoW):
+    """Agent wrapper (ETHMinerAgent.java:162-175)."""
+
+    def get_time_in_seconds(self) -> int:
+        return self._network.time // 1000
+
+    def get_byz_node(self) -> ETHMinerAgent:
+        return self._network.all_nodes[1]
+
+
+def create_agent(byz_hash_power_share: float, rd_seed: int = 0) -> ETHPoWWithAgent:
+    """ETHMinerAgent.create (ETHMinerAgent.java:227-242)."""
+    from ..core.registries import CITIES, builder_name
+
+    bdl_name = builder_name(CITIES, True, 0)
+    nl_name = "NetworkFixedLatency(1000)"
+    params = ETHPoWParameters(bdl_name, nl_name, 10, "ETHMinerAgent", byz_hash_power_share)
+    res = ETHPoWWithAgent(params)
+    res.network().rd.set_seed(rd_seed)
+    return res
+
+
+def try_miner(builder_name_, nl_name, miner, pows, hours, runs, verbose=True):
+    """Strategy evaluation sweep (ETHMiner.java:234-308)."""
+    rows = []
+    if verbose:
+        print(
+            "miner, hashrate ratio, revenue ratio, revenue, uncle rate, "
+            "total revenue, avg difficulty"
+        )
+    miner_cls = resolve_miner_class(miner)
+    for pow_ in pows:
+        params = ETHPoWParameters(builder_name_, nl_name, 10, miner_cls.__name__, pow_)
+        rewards: Dict[int, float] = {1: 0.0}
+        ur = 0.0
+        avg_diff = 0
+        for i in range(1, runs + 1):
+            p = ETHPoW(params)
+            p.network().rd.set_seed(i)
+            p.init()
+            p.network().run(hours * 3600)
+            limit = (5000 if hours > 30 else 0) + p.genesis.height
+            base = p.network().get_node_by_id(1).head
+            j = 0
+            while hours > 30 and j < 5000:
+                base = base.parent
+                j += 1
+            base.all_rewards_by_id(rewards, limit)
+            ur += base.uncle_rate(limit)
+            avg_diff += base.avg_difficulty(limit)
+            p.get_byzantine_node().close()
+        ur /= runs
+        avg_diff //= runs
+        tot = sum(rewards.values())
+        row = {
+            "miner": miner_cls.__name__,
+            "pow": pow_,
+            "rate": rewards[1] / tot if tot else 0.0,
+            "reward": rewards[1] / runs,
+            "uncle_rate": ur,
+            "total": tot / runs,
+            "avg_difficulty": avg_diff,
+        }
+        rows.append(row)
+        if verbose:
+            print(
+                f"{miner_cls.__name__}/{nl_name}/{hours}/{runs}, {pow_:.2f}, "
+                f"{row['rate']:.4f}, {row['reward']:.0f}, {ur:.4f}, "
+                f"{row['total']:.0f}, {avg_diff}"
+            )
+    return rows

@@ -328,7 +328,9 @@ def default_params(
     tor=None,
     loc=None,
 ) -> P2PHandelParameters:
-    """P2PHandelScenarios.defaultParams (P2PHandelScenarios.java:261-277)."""
+    """P2PHandelScenarios.defaultParams (P2PHandelScenarios.java:261-277).
+    dead_ratio / tor / loc are accepted and ignored, exactly like the
+    reference (its own defaultParams never reads them)."""
     ts = int(nodes * 0.99)
     from ..core.registries import CITIES, builder_name
 

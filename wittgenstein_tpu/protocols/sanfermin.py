@@ -127,7 +127,11 @@ class SanFerminNode(Node):
                 )
             else:
                 self._send_swap_reply(node, Status.NO, 0)
-                # a value we might want to keep for later
+                # a value we might want to keep for later — stored in
+                # signature_cache, NOT futur_sigs, mirroring the reference
+                # (SanFerminSignature.java:242-249; its futurSigs map has no
+                # writer either, so the "FUTURe value" fast path is dead
+                # code there too)
                 is_candidate = node in self.candidate_tree.get_candidate_set(request.level)
                 is_valid_sig = True  # as always :)
                 if is_candidate and is_valid_sig:
