@@ -1,0 +1,313 @@
+"""CasperIMD tests (ported from CasperIMDTest.java and
+CasperByzantineTest.java): fork-choice merge, attestation counting across
+branches, too-far attestations, reevaluation, Byzantine producers."""
+
+import pytest
+
+from wittgenstein_tpu.core.latency import NetworkNoLatency
+from wittgenstein_tpu.oracle.blockchain import Block
+from wittgenstein_tpu.protocols.casper import (
+    Attestation,
+    Attester,
+    BlockProducer,
+    ByzBlockProducer,
+    ByzBlockProducerNS,
+    ByzBlockProducerSF,
+    ByzBlockProducerWF,
+    CasperIMD,
+    CasperParameters,
+)
+
+
+@pytest.fixture()
+def ci():
+    Block.reset_block_ids()
+    c = CasperIMD(CasperParameters(5, False, 5, 80, 1000, 1, None, None))
+    c.network().time = 100_000
+    return c
+
+
+@pytest.fixture()
+def nodes(ci):
+    bp1 = BlockProducer(ci, ci.genesis)
+    bp2 = BlockProducer(ci, ci.genesis)
+    at1 = Attester(ci, ci.genesis)
+    at2 = Attester(ci, ci.genesis)
+    return bp1, bp2, at1, at2
+
+
+class TestCasperIMD:
+    def test_init(self, ci):
+        """Task schedule (CasperIMDTest.java:21-40)."""
+        ci.network().time = 0
+        ci.init(ByzBlockProducerWF(ci, 0, ci.genesis))
+        assert ci.params.attesters_count == 5 * 80
+        msgs = ci.network().msgs
+        assert msgs.size_at(1) == 0
+        assert msgs.size_at(8000) == 1  # one block producer starts at second 8
+        assert msgs.size_at(16000) == 1
+        assert msgs.size_at(24000) == 1
+        assert msgs.size_at(32000) == 1
+        assert msgs.size_at(40000) == 1
+        assert msgs.size_at(48000) == 0  # done
+        assert msgs.size_at(12000) == 80  # 80 attesters start at second 12
+        assert msgs.size_at(20000) == 80
+        assert msgs.size_at(28000) == 80
+        assert msgs.size_at(36000) == 80
+        assert msgs.size_at(44000) == 80
+        assert msgs.size_at(52000) == 0  # loops after that
+
+    def test_merge(self, ci, nodes):
+        """(CasperIMDTest.java:42-83)."""
+        bp1, bp2, at1, at2 = nodes
+        b = bp1.build_block(bp1.head, 1)
+        bp1.on_block(b)
+        assert bp1.head is b
+
+        a1 = Attestation(at1, 1)
+        assert len(a1.hs) == 0  # we attest on parents; genesis has none
+        at1.on_block(b)
+        assert at1.head is b
+        at2.on_block(b)
+
+        a1 = Attestation(at1, 1)
+        assert len(a1.hs) == 1
+        assert a1.attests(ci.genesis)
+        assert not a1.attests(b)
+
+        a1 = Attestation(at1, 2)
+        assert len(a1.hs) == 1
+        assert a1.attests(ci.genesis)
+        assert not a1.attests(b)
+
+        bp1.on_attestation(a1)
+        assert b.id in bp1.attestations_by_head
+        assert len(bp1.attestations_by_head[b.id]) == 1
+        assert a1 in bp1.attestations_by_head[b.id]
+        b2 = bp1.build_block(bp1.head, 2)
+        # a block of height 2 can't contain an attestation of height 2
+        assert 2 not in b2.attestations_by_height
+
+        b3 = bp1.build_block(bp1.head, 3)
+        assert 2 in b3.attestations_by_height
+        assert len(b3.attestations_by_height[2]) == 1
+
+        a1 = Attestation(at1, 2)
+        bp1.on_attestation(a1)
+        b3 = bp1.build_block(bp1.head, 3)
+        assert 2 in b3.attestations_by_height
+        assert len(b3.attestations_by_height[2]) == 2
+
+    def test_compare_no_attester(self, ci, nodes):
+        """(CasperIMDTest.java:85-99)."""
+        bp1, bp2, at1, at2 = nodes
+        b = bp1.build_block(bp1.head, 1)
+        bp1.on_block(b)
+        bp2.on_block(b)
+        b1 = bp1.build_block(bp1.head, 2)
+        b2 = bp2.build_block(bp2.head, 3)
+        bp2.on_block(b2)
+        assert bp2.head is b2
+        bp2.on_block(b1)
+        assert bp2.head is not b1  # tie on votes -> block id separates
+
+    def test_count_attestation_received(self, ci, nodes):
+        bp1, bp2, at1, at2 = nodes
+        b = bp1.build_block(bp1.head, 1)
+        bp1.on_block(b)
+        at1.on_block(b)
+        assert bp1.count_attestations(b, ci.genesis) == 0
+        a1 = Attestation(at1, 2)
+        bp1.on_attestation(a1)
+        assert b.id in bp1.attestations_by_head
+        assert bp1.count_attestations(b, ci.genesis) == 1
+
+    def test_count_attestation_in_block(self, ci, nodes):
+        bp1, bp2, at1, at2 = nodes
+        b = bp1.build_block(bp1.head, 1)
+        bp1.on_block(b)
+        at1.on_block(b)
+        assert bp2.count_attestations(b, ci.genesis) == 0
+        a1 = Attestation(at1, 2)
+        bp1.on_attestation(a1)
+        b = bp1.build_block(bp1.head, 3)
+        assert 2 in b.attestations_by_height
+        assert len(b.attestations_by_height[2]) == 1
+        bp2.on_block(b)
+        assert bp2.head is b
+        assert bp2.count_attestations(b, ci.genesis) == 1
+
+    def test_too_far_away_attestation(self, ci, nodes):
+        """(CasperIMDTest.java:141-161)."""
+        bp1, bp2, at1, at2 = nodes
+        b = bp1.build_block(bp1.head, 1)
+        bp1.on_block(b)
+        at1.on_block(b)
+        a1 = Attestation(at1, 2)
+        bp1.on_attestation(a1)
+        b = bp1.build_block(bp1.head, a1.height + ci.params.cycle_length)
+        assert 2 in b.attestations_by_height
+        b = bp1.build_block(bp1.head, a1.height + ci.params.cycle_length + 1)
+        assert 2 not in b.attestations_by_height
+
+    def test_other_branch_attestation(self, ci, nodes):
+        """(CasperIMDTest.java:163-184)."""
+        bp1, bp2, at1, at2 = nodes
+        b1 = bp1.build_block(bp1.head, 1)
+        bp1.on_block(b1)
+        bp2.on_block(b1)
+        at1.on_block(b1)
+        b2 = bp1.build_block(bp1.head, 2)
+        bp1.on_block(b2)
+        at1.on_block(b2)
+        a1 = Attestation(at1, 2)
+        assert b1.id in a1.hs
+        bp2.on_attestation(a1)
+        b3 = bp2.build_block(bp2.head, 3)
+        assert len(b3.attestations_by_height[2]) == 0
+        bp2.on_block(b2)
+        b3 = bp2.build_block(bp2.head, 3)
+        assert len(b3.attestations_by_height[2]) > 0
+
+    def test_compare_with_attester(self, ci, nodes):
+        """(CasperIMDTest.java:186-207)."""
+        bp1, bp2, at1, at2 = nodes
+        b1 = bp1.build_block(bp1.head, 1)
+        bp1.on_block(b1)
+        bp2.on_block(b1)
+        at1.on_block(b1)
+        b2 = bp1.build_block(bp1.head, 2)
+        bp1.on_block(b2)
+        at1.on_block(b2)
+        a1 = Attestation(at1, 2)
+        bp1.on_attestation(a1)
+        b3 = bp1.build_block(bp1.head, 3)
+        assert len(b3.attestations_by_height[2]) == 1
+        b4 = bp2.build_block(bp2.head, 4)
+        bp2.on_block(b4)
+        assert bp2.head is b4
+        bp2.on_block(b3)
+        assert bp2.head is b3
+
+    def test_compare_with_attester_attestation_on_a_parent(self, ci, nodes):
+        """(CasperIMDTest.java:209-227)."""
+        bp1, bp2, at1, at2 = nodes
+        b = bp1.build_block(bp1.head, 1)
+        bp1.on_block(b)
+        bp2.on_block(b)
+        at1.on_block(b)
+        a1 = Attestation(at1, 2)
+        bp1.on_attestation(a1)
+        b1 = bp1.build_block(bp1.head, 3)
+        assert len(b1.attestations_by_height[2]) == 1
+        b2 = bp2.build_block(bp2.head, 4)
+        bp2.on_block(b2)
+        assert bp2.head is b2
+        bp2.on_block(b1)
+        assert bp2.head is b2
+
+    def test_reevaluation(self, ci, nodes):
+        """(CasperIMDTest.java:229-253)."""
+        bp1, bp2, at1, at2 = nodes
+        b1 = bp1.build_block(bp1.head, 1)
+        bp1.on_block(b1)
+        bp2.on_block(b1)
+        b2 = bp1.build_block(bp1.head, 2)
+        b3 = bp1.build_block(bp1.head, 3)
+        bp2.on_block(b2)
+        bp2.on_block(b3)
+        assert bp2.head is b3
+        at1.on_block(b2)
+        a1 = Attestation(at1, 2)
+        assert b1.id in a1.hs
+        bp2.on_attestation(a1)
+        assert b2.id in bp2.attestations_by_head
+        assert bp2.count_attestations(b2, b1) == 1
+        bp2.reevaluate_head()
+        assert bp2.head is b2
+
+    def test_copy(self):
+        """(CasperIMDTest.java:255-276; shorter horizon)."""
+        Block.reset_block_ids()
+        p1 = CasperIMD(CasperParameters(5, False, 5, 80, 1000, 1, None, None))
+        Block.reset_block_ids()
+        p2 = p1.copy()
+        p1.init()
+        p2.init()
+        while p1.network().time < 20000:
+            p1.network().run_ms(10)
+            p2.network().run_ms(10)
+            for n1 in p1.network().all_nodes:
+                n2 = p2.network().get_node_by_id(n1.node_id)
+                assert n2 is not None
+                assert n1.done_at == n2.done_at
+                assert n1.is_down() == n2.is_down()
+                assert n1.head.proposal_time == n2.head.proposal_time
+                assert len(n1.attestations_by_head) == len(n2.attestations_by_head)
+                assert n1.msg_received == n2.msg_received
+
+
+class TestCasperByzantine:
+    def _ci(self):
+        Block.reset_block_ids()
+        c = CasperIMD(CasperParameters(1, False, 2, 2, 1000, 1, None, None))
+        c.network().network_latency = NetworkNoLatency()
+        return c
+
+    def test_byzantine_wf(self):
+        """(CasperByzantineTest.java:11-35)."""
+        ci = self._ci()
+        byz = ByzBlockProducerWF(ci, 0, ci.genesis)
+        ci.init(byz)
+
+        ci.network().run(9)
+        assert ci.network().observer.head is ci.genesis
+
+        ci.network().run(1)  # 10 s: 8 start + 1 build + 1 network
+        assert ci.network().observer.head is not ci.genesis
+        assert ci.network().observer.head.height == 1
+        assert ci.network().observer.head.producer is byz
+
+        ci.network().run(8)  # 18 s
+        assert ci.network().observer.head.height == 2
+        assert ci.network().observer.head.producer is not byz
+
+        ci.network().run(8)  # 26 s
+        assert ci.network().observer.head.height == 3
+        assert ci.network().observer.head.producer is byz
+
+    def test_byzantine_wf_with_delay(self):
+        """(CasperByzantineTest.java:37-65)."""
+        ci = self._ci()
+        byz = ByzBlockProducerWF(ci, -2000, ci.genesis)
+        ci.init(byz)
+
+        ci.network().run(5)
+        assert byz.head.height == 0
+        ci.network().run(1)
+        assert byz.head.height == 1
+        assert ci.network().observer.head.height == 0
+        ci.network().run(2)
+        assert ci.network().observer.head.height == 1
+        ci.network().run(9)
+        assert ci.network().observer.head.height == 1
+        ci.network().run(1)
+        assert byz.head.height == 2
+        assert byz.head.producer is not None
+        assert byz.head.producer is not byz
+        ci.network().run(3)
+        assert byz.head.height == 2
+        ci.network().run(1)  # 22 s: 24 - 2 s delay
+        assert byz.head.height == 3
+
+    def test_byzantine_variants_run(self):
+        """ByzBlockProducer / SF / NS each drive a run without errors
+        (CasperByzantineTest pattern extended to all variants)."""
+        for cls in (ByzBlockProducer, ByzBlockProducerSF, ByzBlockProducerNS):
+            Block.reset_block_ids()
+            ci = CasperIMD(CasperParameters(2, False, 3, 4, 1000, 1, None, None))
+            ci.network().network_latency = NetworkNoLatency()
+            byz = cls(ci, 0, ci.genesis)
+            ci.init(byz)
+            ci.network().run(60)
+            assert ci.network().observer.head.height >= 3

@@ -40,7 +40,7 @@ class WParameters:
             klass = _params_types.get(typ)
             if klass is None:
                 raise KeyError(f"unknown parameters type {typ!r}")
-        fields = {f.name for f in dataclasses.fields(klass)}
+        fields = {f.name for f in dataclasses.fields(klass) if f.init}
         return klass(**{k: v for k, v in d.items() if k in fields})
 
     def __init_subclass__(cls, **kw):
