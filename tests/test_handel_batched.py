@@ -1,10 +1,16 @@
-"""Batched Handel: convergence, oracle distributional parity, batching."""
+"""Batched Handel: convergence, quantile-level oracle parity, Byzantine
+attacks, batching/determinism.
+
+The parity bar here is distributional (BASELINE.json: time-to-aggregation
+CDFs within a few % of the Java-semantics oracle): P10/P50/P90 of doneAt
+over oracle seeds vs batched replicas, plus attack-mode mean parity.
+"""
 
 import numpy as np
+import pytest
 
 from wittgenstein_tpu.core.registries import builder_name
-from wittgenstein_tpu.core.runners import RunMultipleTimes
-from wittgenstein_tpu.engine import replicate_state
+from wittgenstein_tpu.engine import replicate_state, stack_states
 from wittgenstein_tpu.protocols.handel import Handel, HandelParameters
 from wittgenstein_tpu.protocols.handel_batched import make_handel
 
@@ -27,6 +33,26 @@ def make_params(**kw):
     )
     base.update(kw)
     return HandelParameters(**base)
+
+
+def oracle_done_at(params: HandelParameters, seeds, run_ms: int) -> np.ndarray:
+    """doneAt of every live node across `seeds` oracle runs."""
+    out = []
+    for seed in seeds:
+        p = Handel(params)
+        p.network().rd.set_seed(seed)
+        p.init()
+        p.network().run_ms(run_ms)
+        out += [n.done_at for n in p.network().live_nodes()]
+    return np.asarray(out)
+
+
+def batched_done_at(params: HandelParameters, n_replicas: int, run_ms: int) -> np.ndarray:
+    net, state = make_handel(params)
+    states = replicate_state(state, n_replicas)
+    out = net.run_ms_batched(states, run_ms)
+    done = np.asarray(out.done_at)
+    return done[~np.asarray(out.down)]
 
 
 class TestBatchedHandel:
@@ -63,26 +89,93 @@ class TestBatchedHandel:
         assert (done[~down] > 0).all()
         assert (done[down] == 0).all()
 
-    def test_oracle_distributional_parity(self):
-        """Mean time-to-threshold within 25% of the oracle Handel (the
-        batched path approximates scoring/ranks — CDF shape, not exactness)."""
-        p = make_params(node_count=64, threshold=60)
-        oracle = Handel(p)
-        oracle.init()
-        cont = RunMultipleTimes.cont_until_done()
-        while cont(oracle) and oracle.network().time < 20000:
-            oracle.network().run_ms(500)
-        o_done = np.array([n.done_at for n in oracle.network().live_nodes()])
-        assert (o_done > 0).all()
+    def test_oracle_quantile_parity(self):
+        """P10/P50/P90 of time-to-threshold within 8% of the oracle DES
+        (replaces the old ±25% mean-only check)."""
+        p = make_params(node_count=64, threshold=63)
+        o = oracle_done_at(p, range(12), 2000)
+        assert (o > 0).all()
+        b = batched_done_at(p, 16, 2000)
+        assert (b > 0).all()
+        oq = np.percentile(o, [10, 50, 90])
+        bq = np.percentile(b, [10, 50, 90])
+        rel = np.abs(bq - oq) / oq
+        assert (rel <= 0.08).all(), (oq, bq, rel)
 
-        net, state = make_handel(p)
-        state = net.run_ms(state, 20000)
-        b_done = np.asarray(state.done_at)
-        assert (b_done > 0).all()
-        assert abs(b_done.mean() - o_done.mean()) <= 0.25 * o_done.mean(), (
-            b_done.mean(),
-            o_done.mean(),
+    @pytest.mark.parametrize("attack", ["byzantine_suicide", "hidden_byzantine"])
+    def test_attack_parity(self, attack):
+        """Under each attack at 25% Byzantine, every live node still
+        completes and the mean time-to-threshold tracks the oracle within
+        12% (measured ~2%)."""
+        n, nd = 64, 16
+        kw = {attack: True}
+        p = make_params(node_count=n, threshold=int((n - nd) * 0.99), nodes_down=nd, **kw)
+        o = oracle_done_at(p, range(6), 3000)
+        b = batched_done_at(p, 8, 3000)
+        assert (o > 0).all()
+        assert (b > 0).all()
+        assert abs(b.mean() - o.mean()) <= 0.12 * o.mean(), (o.mean(), b.mean())
+
+    def test_attack_slows_aggregation(self):
+        """The suicide attack must cost time vs an attack-free run with the
+        same number of plainly-dead nodes (wasted verifications+blacklist)."""
+        n, nd = 64, 16
+        base = make_params(node_count=n, threshold=int((n - nd) * 0.99), nodes_down=nd)
+        atk = make_params(
+            node_count=n,
+            threshold=int((n - nd) * 0.99),
+            nodes_down=nd,
+            byzantine_suicide=True,
         )
+        b0 = batched_done_at(base, 8, 3000)
+        b1 = batched_done_at(atk, 8, 3000)
+        assert b1.mean() > b0.mean()
+
+    def test_suicide_blacklists_byzantine_peers(self):
+        n, nd = 64, 16
+        p = make_params(
+            node_count=n,
+            threshold=int((n - nd) * 0.99),
+            nodes_down=nd,
+            byzantine_suicide=True,
+        )
+        net, state = make_handel(p)
+        state = net.run_ms(state, 3000)
+        bl = np.asarray(state.proto["bl"])
+        live = ~np.asarray(state.down)
+        # blacklists are nonempty and only ever name Byzantine (down) peers:
+        # bl is in rel space and byz holds the down set in rel space
+        byz = np.asarray(state.proto["byz"])
+        assert (bl[live] & ~byz[live]).sum() == 0
+        per_node = np.unpackbits(
+            np.ascontiguousarray(bl[live]).view(np.uint8)
+        ).sum() / live.sum()
+        assert per_node > 1.0  # each live node blacklisted several attackers
+
+    def test_byzantine_sweep_batched(self):
+        """The north-star 0-25% Byzantine sweep as ONE batched computation:
+        stacked replicas with different down fractions, monotone slowdown."""
+        n = 64
+        fracs = [0.05, 0.10, 0.25]
+        nets, states = [], []
+        for f in fracs:
+            nd = int(n * f)
+            p = make_params(
+                node_count=n,
+                threshold=int(n * 0.70),
+                nodes_down=nd,
+                byzantine_suicide=True,
+            )
+            net, st = make_handel(p)
+            nets.append(net)
+            states.append(st)
+        stacked = stack_states(states)
+        out = nets[0].run_ms_batched(stacked, 3000)
+        done = np.asarray(out.done_at)
+        down = np.asarray(out.down)
+        means = [done[i][~down[i]].mean() for i in range(len(fracs))]
+        assert all((done[i][~down[i]] > 0).all() for i in range(len(fracs)))
+        assert means[0] < means[-1], means
 
     def test_replicas_and_determinism(self):
         net, state = make_handel(make_params(node_count=32, threshold=30))
@@ -102,3 +195,26 @@ class TestBatchedHandel:
         assert int(np.asarray(state.proto["start_at"]).max()) > 0
         state = net.run_ms(state, 5000)
         assert (np.asarray(state.done_at) > 0).all()
+
+    def test_window_adapts(self):
+        """Suicide attacks shrink verification windows (bad verifications
+        divide the window, ScoringExp Handel.java:179-210)."""
+        n, nd = 64, 16
+        p = make_params(
+            node_count=n,
+            threshold=int((n - nd) * 0.99),
+            nodes_down=nd,
+            byzantine_suicide=True,
+        )
+        net, state = make_handel(p)
+        state = net.run_ms(state, 300)
+        w = np.asarray(state.proto["window"])
+        live = ~np.asarray(state.down)
+        assert w[live].min() >= p.window_minimum
+        assert w[live].max() <= p.window_maximum
+        # some node hit a forged sig and shrank below the initial size
+        assert (w[live] < p.window_initial).any()
+
+    def test_node_count_cap_guard(self):
+        with pytest.raises(NotImplementedError):
+            make_handel(make_params(node_count=1 << 15, threshold=100))

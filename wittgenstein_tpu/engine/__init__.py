@@ -8,7 +8,7 @@ under `jax.lax.scan`, `jax.vmap` over replicas, and `jax.sharding` over
 devices.
 """
 
-from .core import BatchedNetwork, Emission, SimState, replicate_state
+from .core import BatchedNetwork, Emission, SimState, replicate_state, stack_states
 from .protocol import BatchedProtocol
 from .rng import hash32, pseudo_delta
 
@@ -20,4 +20,5 @@ __all__ = [
     "hash32",
     "pseudo_delta",
     "replicate_state",
+    "stack_states",
 ]

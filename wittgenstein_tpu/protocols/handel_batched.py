@@ -4,42 +4,86 @@ Re-expression of protocols/Handel.java for the batched time-stepped core.
 State is packed uint32 bitsets in the XOR-relative layout (ops.bitops):
 bit j of node i's vector is node i^j, so every node shares the same level
 geometry — level l = bit block [2^(l-1), 2^l) (Handel.allSigsAtLevel,
-Handel.java:634-647, becomes a static mask).
+Handel.java:634-647, becomes a static mask), and re-addressing a level-l
+contribution from sender s into receiver i's space is the bit permutation
+j -> j ^ r0 with r0 = (i^s) & (2^(l-1)-1).
 
-Messaging uses a protocol-specific channel instead of the generic ring
-(SURVEY §7 "per-protocol message representations"): D in-flight slots per
-(receiver, level), slot = arrival mod D, each holding
-((arrival - now)<<REL_BITS | sender_rel, content) — time-RELATIVE keys,
-decremented once per tick, so the packing never overflows int32 no matter
-the simulation horizon.  Earliest arrival wins a slot;
-displaced sends are simply lost — Handel is a gossip protocol whose
-periodic dissemination re-offers content every period, which is exactly
-the redundancy the reference relies on for dropped/filtered messages.
-Delivery is then pure elementwise work on [N, L, D] arrays — no scatters
-on the delivery path, and memory is O(N·L·D·W) regardless of traffic.
+Memory layout (what makes 4096 nodes x 32 replicas fit in HBM): level l's
+outgoing content is only bits [0, 2^(l-1)) — w_l = max(1, 2^(l-1)/32)
+words — so all per-level buffers are packed into ONE flat word axis of
+W_total = sum_l w_l words (132 for n=4096) instead of a uniform
+[L, n_words/2] block (6.3x smaller, and it avoids XLA's (8,128) tile
+padding on small minor dimensions).
 
-Mapping from the reference (semantics deltas are deliberate,
-distribution-parity approximations — each is noted):
+Three buffer stages per (receiver, level), mirroring the reference's
+message + toVerifyAgg + pairing pipeline:
 
-  * SendSigs content (totalOutgoing at the level = bits [0, 2^(l-1)) of
-    the sender's vector) is captured exactly at send time in the slot;
-  * the per-level toVerifyAgg queue becomes a one-candidate register
-    pend_key[N, L] + cand_sig[N, L, W/2], preferring fuller content (the
-    stand-in for bestToVerify's added-sigs scoring, Handel.java:566-630);
-  * checkSigs' uniformly-random choice among per-level bests
-    (Handel.java:788-790) is kept, via a counter-hash draw;
-  * verification completion follows updateVerifiedSignatures exactly:
-    verified individual bit, replace-on-intersect lastAgg, totalIncoming =
-    agg | ind, threshold -> doneAt (Handel.java:686-750);
-  * fastPath: on completing a level's incoming set, burst-send to
+  1. in-flight channel: D slots keyed by ((arrival-now)<<rel_bits | rel),
+     slot = arrival mod D, earliest arrival wins; displaced sends are
+     lost — Handel's periodic dissemination re-offers content every
+     period, exactly the redundancy the reference relies on for its own
+     dropped/filtered messages.  Content is stored in SENDER bit space.
+  2. candidate buffer (toVerifyAgg, Handel.java:447): K slots of arrived,
+     not-yet-verified aggregate sigs in receiver block-local space,
+     curated exactly like bestToVerify's pruning — a candidate survives
+     only while sizeIfIncluded > |totalIncoming| and its sender is not
+     blacklisted (Handel.java:592-612); arrivals beyond K displace the
+     lowest-(sizeIfIncluded, -rank) entry.
+  3. verification register: one in-progress verification per node;
+     selection at time t commits its merge at t + pairingTime
+     (checkSigs -> registerTask(updateVerifiedSignatures, now +
+     nodePairingTime), Handel.java:833-836) — the node is busy meanwhile,
+     preserving the 1-verification-per-pairingTime capacity model.
+
+Semantics carried exactly (Handel.java refs):
+  * windowed scoring: windowIndex = min rank in the queue, rank-based
+    choice outside the window, score-based inside (bestToVerify,
+    :566-630); score() = added-signature count with the
+    non-intersecting/with-individuals cases (:650-664); exponential
+    window adaptation ceil(*2)/floor(/4) clamped to [min, max] and the
+    chosen level's size (WindowParameters/ScoringExp :150-210, applied at
+    :823-825).
+  * updateVerifiedSignatures (:686-750): blacklist on bad sigs;
+    verifiedInd bit; the **improved guard** — lastAggVerified is only
+    replaced/extended when |sig ∪ ind| > |ind|, so a verified aggregate
+    can never shrink; totalIncoming = lastAgg | ind; fastPath burst to
     fast_path peers of the first higher level whose outgoing just
-    completed (Handel.java:738-742);
-  * extraCycle dissemination continuation after done; incoming is
-    filtered (msg_filtered) once done (Handel.java:752-756);
-  * emission order is a counter-hash offset + cycling cursor (stands in
-    for the reception-rank emission lists, Handel.java:991-1013).
+    completed (:738-742); doneAt when the cross-level union reaches the
+    threshold (:747-749).
+  * byzantineSuicide (:538-559): while un-blacklisted down Byzantine
+    peers with rank inside windowIndex+window exist at a level, a forged
+    full-block sig from one of them is returned as that level's
+    bestToVerify result directly; verifying it wastes pairingTime and
+    blacklists the sender (:687-694).
+  * hiddenByzantine (:840-917): when the chosen best is at the top level,
+    a valid single-bit sig from the lowest-rank down Byzantine peer not
+    yet in totalIncoming competes by score; if it wins the node wastes a
+    verification on a nearly-useless contribution.
+  * uniform-random choice among per-level bests (chooseBestFromLevels,
+    :788-790), extraCycle post-done dissemination (:331-338), done-node
+    message filtering (msgFiltered, :752-756), desynchronizedStart,
+    per-node pairing time scaled by speedRatio.
 
-Byzantine attack modes are not yet ported to the batched path.
+Distribution-parity approximations (deliberate, each noted inline):
+  * reception ranks: the reference shuffles one global [N] permutation
+    per receiver (setReceivingRanks :940-948); here rank(i, l, rel) is a
+    counter-hash bijection over the level block scaled to the same [0, N)
+    range.  The post-verification demotion (receptionRanks[from] +=
+    nodeCount, :826-830) becomes a +N penalty whenever the sender's
+    individual sig is already verified.
+  * emission order (:991-1013) is a counter-hash offset + cycling cursor
+    per level rather than the rank-derived emission lists; finished-peer
+    bookkeeping (levelFinished/finishedPeers) is not tracked.
+  * suicide-byz picks the lowest-block-index eligible peer, not the
+    suicideBizAfter cursor order; hidden-byz re-attempts injection each
+    selection instead of tracking the `last` candidate.
+  * same-ms deliveries are simultaneous; per-ms LIFO order inside the
+    oracle's buckets has no analog.
+
+int32 packing guards: channel keys pack (arrival - now) << rel_bits | rel
+and candidate sort keys pack sizeIfIncluded * 4N + rank, so node_count is
+capped at 2^14 (16384) — far above the 4096-node north star — and
+construction fails loudly beyond it rather than overflowing.
 """
 
 from __future__ import annotations
@@ -59,34 +103,52 @@ from ..utils.javarand import JavaRandom
 from .handel import HandelParameters
 
 INT32_MAX = np.int32(2**31 - 1)
+MAX_NODES = 1 << 14  # int32 key-packing headroom (see module docstring)
 
 
 class BatchedHandel(BatchedProtocol):
     TICK_INTERVAL = 1  # verification capacity is modeled per-ms
     PAYLOAD_WIDTH = 0  # messaging bypasses the generic ring entirely
-    CHANNEL_DEPTH = 8  # in-flight slots per (receiver, level)
+    CHANNEL_DEPTH = 8  # D: in-flight slots per (receiver, level)
+    CAND_SLOTS = 8  # K: arrived verification candidates per (receiver, level)
 
     def __init__(self, params: HandelParameters):
         self.params = params
         n = params.node_count
         if n & (n - 1):
             raise ValueError("power-of-two node counts only")
+        if n > MAX_NODES:
+            raise NotImplementedError(
+                f"node_count {n} > {MAX_NODES}: int32 channel/sort key packing "
+                "would overflow; widen the keys before raising this cap"
+            )
         self.n_nodes = n
         self.n_words = max(1, n // 32)
         self.n_levels = n.bit_length()  # levels 0..log2(n)
-        # outgoing content at any level fits in the low half of the vector
-        self.out_words = max(1, self.n_words // 2)
-        self.MSG_TYPES = [f"SIGS_L{l}" for l in range(self.n_levels)]
         self.rel_bits = max(1, (n - 1).bit_length())
-        # static level masks
+        self.MSG_TYPES = [f"SIGS_L{l}" for l in range(self.n_levels)]
+
+        # per-level content geometry: level l's outgoing payload is bits
+        # [0, 2^(l-1)) = w_l words at flat offset off_l
+        self.w = [0] * self.n_levels
+        self.off = [0] * self.n_levels
+        acc = 0
+        for l in range(1, self.n_levels):
+            self.w[l] = max(1, (1 << (l - 1)) // 32)
+            self.off[l] = acc
+            acc += self.w[l]
+        self.w_total = acc
+        self.w_max = self.w[self.n_levels - 1] if self.n_levels > 1 else 1
+
+        # static full-width level masks (receiver rel space)
         self.level_masks = np.stack(
             [level_block_mask(l, self.n_words) for l in range(self.n_levels)]
         )
         low = np.zeros_like(self.level_masks)
-        acc = np.zeros(self.n_words, dtype=np.uint32)
+        acc_m = np.zeros(self.n_words, dtype=np.uint32)
         for l in range(self.n_levels):
-            low[l] = acc  # bits below level l's block == outgoing content
-            acc = acc | self.level_masks[l]
+            low[l] = acc_m  # bits below level l's block == outgoing content
+            acc_m = acc_m | self.level_masks[l]
         self.low_masks = low
 
     def msg_size(self, mtype: int) -> int:
@@ -95,24 +157,133 @@ class BatchedHandel(BatchedProtocol):
         expected = 1 if mtype == 0 else 1 << (mtype - 1)
         return 1 + expected // 8 + 96 * 2
 
+    # -- block-local helpers -------------------------------------------------
+    # receiver rel space block [2^(l-1), 2^l) <-> block-local bits [0, 2^(l-1))
+    def _blk(self, x, l: int):
+        """Level-l block of full-width vectors [..., W] -> [..., w_l]."""
+        bs = 1 << (l - 1)
+        if bs >= 32:
+            return x[..., bs // 32 : (2 * bs) // 32]
+        return (x[..., 0:1] >> jnp.uint32(bs)) & jnp.uint32((1 << bs) - 1)
+
+    def _blk_write(self, x, l: int, blk, where):
+        """Write block-local [..., w_l] back into full-width [..., W]."""
+        bs = 1 << (l - 1)
+        if bs >= 32:
+            new = jnp.where(where[..., None], blk, x[..., bs // 32 : (2 * bs) // 32])
+            return x.at[..., bs // 32 : (2 * bs) // 32].set(new)
+        m = jnp.uint32(((1 << bs) - 1) << bs)
+        w0 = (x[..., 0] & ~m) | ((blk[..., 0] << jnp.uint32(bs)) & m)
+        return x.at[..., 0].set(jnp.where(where, w0, x[..., 0]))
+
+    def _low(self, x, l: int):
+        """Sender-space outgoing content at level l: bits [0, 2^(l-1))."""
+        bs = 1 << (l - 1)
+        if bs >= 32:
+            return x[..., : bs // 32]
+        return x[..., 0:1] & jnp.uint32((1 << bs) - 1)
+
+    @staticmethod
+    def _onehot(r0, w: int):
+        """Block-local one-hot bit r0: [...] int32 -> [..., w] uint32."""
+        word = r0 >> 5
+        bit = (r0 & 31).astype(jnp.uint32)
+        return jnp.where(
+            jnp.arange(w, dtype=jnp.int32) == word[..., None],
+            (jnp.uint32(1) << bit)[..., None],
+            jnp.uint32(0),
+        )
+
+    @staticmethod
+    def _lowest_bit(words):
+        """Index of the lowest set bit of packed [N, w] uint32 vectors
+        (undefined when empty — gate on popcount > 0)."""
+        word_nz = words != 0
+        widx = jnp.argmax(word_nz, axis=1).astype(jnp.int32)
+        wval = jnp.take_along_axis(words, widx[:, None], axis=1)[:, 0]
+        lowbit = popcount_words(((wval & (-wval).astype(jnp.uint32)) - 1)[:, None])
+        return widx * 32 + lowbit
+
+    def _getbit(self, x, pos):
+        """Bit `pos` of full-width [N, W] vectors; pos is [N, ...] int32."""
+        word = jnp.take_along_axis(
+            x, (pos >> 5).reshape(pos.shape[0], -1), axis=1
+        ).reshape(pos.shape)
+        return (word >> (pos & 31).astype(jnp.uint32)) & jnp.uint32(1)
+
+    # -- channel segment views ----------------------------------------------
+    def _fresh_cols(self) -> np.ndarray:
+        """bool[(L-1)*(D+1)]: which in_key columns are fresh-backstop slots."""
+        ss = self.CHANNEL_DEPTH + 1
+        cols = np.zeros((self.n_levels - 1) * ss, dtype=bool)
+        cols[ss - 1 :: ss] = True
+        return cols
+
+    def _key_seg(self, in_key, l: int):
+        ss = self.CHANNEL_DEPTH + 1
+        return in_key[:, (l - 1) * ss : l * ss]
+
+    def _sig_seg(self, sig_flat, l: int, slots: int):
+        n = sig_flat.shape[0]
+        o, w = self.off[l] * slots, self.w[l] * slots
+        return sig_flat[:, o : o + w].reshape(n, slots, self.w[l])
+
+    # -- ranks ---------------------------------------------------------------
+    def _base_rank(self, seed, ids, l: int, rel):
+        """Counter-hash stand-in for the reference's global reception-rank
+        permutation (setReceivingRanks, Handel.java:940-948): a bijection
+        over the level block scaled to the [0, N) range so windowIndex +
+        currWindowSize comparisons see reference-like rank spacing."""
+        bs = 1 << (l - 1)
+        r0 = rel & (bs - 1)
+        mul = hash32(seed, ids, jnp.int32(l), jnp.int32(0xA11CE)) | jnp.int32(1)
+        add = hash32(seed, ids, jnp.int32(l), jnp.int32(0xBEEF))
+        perm = (r0 * mul + add) & (bs - 1)
+        gap = self.n_nodes // bs
+        if gap > 1:
+            jit = hash32(seed, ids, rel, jnp.int32(l)) & jnp.int32(gap - 1)
+            return perm * gap + jit
+        return perm
+
     # -- state ---------------------------------------------------------------
-    def proto_init(self, n_nodes: int, pairing: np.ndarray, start_at: np.ndarray):
-        n, L = self.n_nodes, self.n_levels
+    def proto_init(
+        self,
+        n_nodes: int,
+        pairing: np.ndarray,
+        start_at: np.ndarray,
+        byz_rel: Optional[np.ndarray] = None,
+    ):
+        n, L, D, K = self.n_nodes, self.n_levels, self.CHANNEL_DEPTH, self.CAND_SLOTS
         own = np.zeros((n, self.n_words), dtype=np.uint32)
         own[:, 0] = 1  # bit 0 = own signature (level 0)
+        if byz_rel is None:
+            byz_rel = np.zeros((n, self.n_words), dtype=np.uint32)
         return {
             "agg": jnp.asarray(own),  # lastAggVerified per level block
             "ind": jnp.asarray(own),  # verifiedIndSignatures
             "inc": jnp.asarray(own),  # totalIncoming = agg | ind
-            # in-flight channel: D slots per (receiver, level)
-            "in_key": jnp.full((n, L, self.CHANNEL_DEPTH), INT32_MAX, jnp.int32),
-            "in_sig": jnp.zeros(
-                (n, L, self.CHANNEL_DEPTH, self.out_words), jnp.uint32
+            "bl": jnp.zeros((n, self.n_words), jnp.uint32),  # blacklist (rel)
+            "byz": jnp.asarray(byz_rel),  # down Byzantine peers (rel space)
+            # stage 1: in-flight channel (D arrival slots + 1 fresh backstop
+            # per level; fresh slots are empty at -1, arrival slots at MAX)
+            "in_key": jnp.asarray(
+                np.where(self._fresh_cols(), -1, INT32_MAX)
+                * np.ones((n, 1), np.int32),
+                jnp.int32,
             ),
-            # verification candidate per (receiver, level)
-            "pend_key": jnp.full((n, L), INT32_MAX, jnp.int32),
-            "cand_sig": jnp.zeros((n, L, self.out_words), jnp.uint32),
-            "busy_until": jnp.zeros(n, jnp.int32),
+            "in_sig": jnp.zeros((n, (D + 1) * self.w_total), jnp.uint32),
+            # stage 2: candidate buffer (toVerifyAgg)
+            "cand_rank": jnp.full((n, (L - 1) * K), INT32_MAX, jnp.int32),
+            "cand_rel": jnp.zeros((n, (L - 1) * K), jnp.int32),
+            "cand_sig": jnp.zeros((n, K * self.w_total), jnp.uint32),
+            # stage 3: verification register
+            "ver_active": jnp.zeros(n, bool),
+            "ver_done_t": jnp.zeros(n, jnp.int32),
+            "ver_level": jnp.zeros(n, jnp.int32),
+            "ver_rel": jnp.zeros(n, jnp.int32),
+            "ver_bad": jnp.zeros(n, bool),
+            "ver_sig": jnp.zeros((n, self.w_max), jnp.uint32),
+            "window": jnp.full(n, self.params.window_initial, jnp.int32),
             "pos": jnp.zeros((n, L), jnp.int32),
             "added_cycle": jnp.full(n, self.params.extra_cycle, jnp.int32),
             "sigs_checked": jnp.zeros(n, jnp.int32),
@@ -121,97 +292,290 @@ class BatchedHandel(BatchedProtocol):
             "start_at": jnp.asarray(start_at, jnp.int32),
         }
 
-    # -- helpers -------------------------------------------------------------
-    def _outgoing_complete(self, inc, level: int) -> jnp.ndarray:
-        want = 1 if level == 1 else 1 << (level - 1)
-        low = jnp.asarray(self.low_masks[level])
-        return popcount_words(inc & low) == want
-
-    def _incoming_complete(self, inc, level: int) -> jnp.ndarray:
-        want = 1 << (level - 1)
-        m = jnp.asarray(self.level_masks[level])
-        return popcount_words(inc & m) == want
-
-    def _send(self, net, state, mask, from_idx, to_idx, lv, content):
-        """Send K messages into the per-(receiver, level, arrival%D) slot;
-        earliest arrival wins a slot, ties broken by sender rel index."""
+    # -- send path (per level) ----------------------------------------------
+    def _send_level(self, net, state, l: int, mask, from_idx, to_idx, content):
+        """Send K messages at level l into the per-(receiver, slot) channel;
+        earliest arrival wins a slot, ties broken by sender rel.  Content is
+        sender-space [K, w_l]."""
         proto = state.proto
+        d = self.CHANNEL_DEPTH
         state, ok, arrival = net.latency_arrivals(
-            state, mask, from_idx, to_idx, state.time + 1, lv
+            state, mask, from_idx, to_idx, state.time + 1, jnp.int32(l)
+        )
+        # receiver traffic counters tick here, at send time: every ok send is
+        # delivered by the oracle (Network.java:611-612), but the channel may
+        # displace it — counting at send keeps end-of-run totals exact at the
+        # cost of counters leading arrivals by the latency
+        okc = ok.astype(jnp.int32)
+        state = state._replace(
+            msg_received=state.msg_received.at[to_idx].add(okc, mode="drop"),
+            bytes_received=state.bytes_received.at[to_idx].add(
+                okc * self.msg_size(l), mode="drop"
+            ),
         )
         rel = (to_idx ^ from_idx).astype(jnp.int32)
-        slot = lax.rem(arrival, jnp.int32(self.CHANNEL_DEPTH))
-        # time-relative arrival (>= 2): decremented per tick in
-        # _channel_deliver, so the key packing never overflows
+        # time-relative arrival (>= 1): decremented per tick in
+        # _channel_deliver, so the packing never overflows int32
         rel_arr = arrival - state.time
         key = jnp.where(ok, (rel_arr << self.rel_bits) | rel, INT32_MAX)
+        ss = d + 1  # slots per (receiver, level): d arrival-keyed + 1 fresh
+
+        # arrival-keyed slots: earliest arrival wins (timely delivery path);
+        # displaced sends are covered by periodic re-offers and the backstop
+        slot = lax.rem(arrival, jnp.int32(d))
+        col = (l - 1) * ss + slot
         safe_to = jnp.where(ok, to_idx, self.n_nodes)
-        new_key = proto["in_key"].at[safe_to, lv, slot].min(key, mode="drop")
-        winner = ok & (new_key[to_idx, lv, slot] == key)
+        new_key = proto["in_key"].at[safe_to, col].min(key, mode="drop")
+        winner = ok & (new_key[to_idx, col] == key)
+
+        # the freshest-message backstop slot: always overwritten by the
+        # newest offer, so when a level's traffic dies out the last content
+        # a laggard was sent still delivers instead of being displaced —
+        # the finite-shape stand-in for the oracle's never-dropping queue
+        # fresh slots are empty at -1 (so any real key wins the max; an
+        # INT32_MAX empty would win it forever)
+        fcol = (l - 1) * ss + d
+        new_key = new_key.at[safe_to, fcol].max(jnp.where(ok, key, -1), mode="drop")
+        fresh_win = ok & (new_key[to_idx, fcol] == key)
+
         win_to = jnp.where(winner, to_idx, self.n_nodes)
-        new_sig = proto["in_sig"].at[win_to, lv, slot].set(
+        wcols = (ss * self.off[l] + slot[:, None] * self.w[l]) + jnp.arange(
+            self.w[l], dtype=jnp.int32
+        )
+        new_sig = proto["in_sig"].at[win_to[:, None], wcols].set(
             content.astype(jnp.uint32), mode="drop"
         )
-        return state._replace(
-            proto=dict(proto, in_key=new_key, in_sig=new_sig)
+        fwin_to = jnp.where(fresh_win, to_idx, self.n_nodes)
+        fwcols = (ss * self.off[l] + d * self.w[l]) + jnp.arange(
+            self.w[l], dtype=jnp.int32
         )
+        new_sig = new_sig.at[fwin_to[:, None], fwcols[None, :]].set(
+            content.astype(jnp.uint32), mode="drop"
+        )
+        return state._replace(proto=dict(proto, in_key=new_key, in_sig=new_sig))
 
-    # -- tick phases ---------------------------------------------------------
-    def _channel_deliver(self, net, state):
-        """Promote due in-flight slots into the verification candidate
-        register (onNewSig, Handel.java:752-786) — pure elementwise."""
+    # -- tick phase 1: commit due verifications ------------------------------
+    def _commit(self, net, state):
+        """updateVerifiedSignatures at t = selection + pairingTime
+        (Handel.java:686-750)."""
+        p = self.params
         proto = state.proto
         t = state.time
-        # advance relative arrivals by one tick, then deliver the due ones
-        occupied = proto["in_key"] != INT32_MAX
+        n, L = self.n_nodes, self.n_levels
+        ids = jnp.arange(n, dtype=jnp.int32)
+
+        due = proto["ver_active"] & (t >= proto["ver_done_t"])
+        bad = due & proto["ver_bad"]
+        good = due & ~proto["ver_bad"]
+
+        # bad sig: blacklist the sender, nothing else (:687-694)
+        rel = proto["ver_rel"]
+        oh_full = self._onehot(rel, self.n_words)
+        new_bl = jnp.where(bad[:, None], proto["bl"] | oh_full, proto["bl"])
+
+        agg, ind, inc = proto["agg"], proto["ind"], proto["inc"]
+        improved_any = jnp.zeros(n, bool)
+        just_completed = jnp.zeros(n, bool)
+        for l in range(1, L):
+            m = good & (proto["ver_level"] == l)
+            bs = 1 << (l - 1)
+            r0 = rel & (bs - 1)
+            sig_b = proto["ver_sig"][:, : self.w[l]]
+            ind_b = self._blk(ind, l)
+            agg_b = self._blk(agg, l)
+            inc_b = self._blk(inc, l)
+            sender = self._onehot(r0, self.w[l])
+
+            new_ind_b = ind_b | sender
+            # the improved guard: extend/replace lastAgg ONLY when the
+            # candidate plus individuals is strictly larger (:716-722)
+            improved2 = popcount_words(sig_b | new_ind_b) > popcount_words(new_ind_b)
+            inter = popcount_words(agg_b & sig_b) > 0
+            new_agg_b = jnp.where(
+                (improved2 & inter)[:, None], sig_b, agg_b | jnp.where(
+                    improved2[:, None], sig_b, jnp.uint32(0)
+                )
+            )
+            new_inc_b = jnp.where(
+                improved2[:, None], new_agg_b | new_ind_b, inc_b | sender
+            )
+            improved1 = popcount_words(inc_b & sender) == 0
+            improved = m & (improved1 | improved2)
+
+            before_full = popcount_words(inc_b) == bs
+            after_full = popcount_words(new_inc_b) == bs
+            just_completed = just_completed | (improved & after_full & ~before_full)
+            improved_any = improved_any | improved
+
+            ind = self._blk_write(ind, l, new_ind_b, m)
+            agg = self._blk_write(agg, l, new_agg_b, m & improved2)
+            inc = self._blk_write(inc, l, new_inc_b, m)
+
+        total = popcount_words(inc)
+        done_now = (
+            improved_any & (state.done_at == 0) & ~state.down & (total >= p.threshold)
+        )
+        state = state._replace(
+            done_at=jnp.where(done_now, t, state.done_at),
+            proto=dict(
+                proto,
+                agg=agg,
+                ind=ind,
+                inc=inc,
+                bl=new_bl,
+                ver_active=proto["ver_active"] & ~due,
+            ),
+        )
+
+        # fastPath burst (:738-742): on completing a level's incoming set,
+        # contact fast_path peers of the first higher level whose outgoing
+        # is now complete but whose incoming is not
+        if p.fast_path > 0 and L > 1:
+            out_done = jnp.stack(
+                [
+                    popcount_words(self._low(inc, l)) == (1 if l == 1 else 1 << (l - 1))
+                    for l in range(1, L)
+                ],
+                axis=1,
+            )
+            inc_done = jnp.stack(
+                [
+                    popcount_words(self._blk(inc, l)) == (1 << (l - 1))
+                    for l in range(1, L)
+                ],
+                axis=1,
+            )
+            target_ok = out_done & ~inc_done
+            has_target = jnp.any(target_ok, axis=1)
+            lsel = (jnp.argmax(target_ok, axis=1) + 1).astype(jnp.int32)
+            fp_mask_base = just_completed & has_target
+            fp = min(p.fast_path, max(1, self.n_nodes // 2))
+            ks = jnp.arange(fp, dtype=jnp.int32)
+            offset = hash32(state.seed, ids, lsel, t)
+            for l in range(1, L):
+                bs = 1 << (l - 1)
+                fpl = min(fp, bs)
+                m = fp_mask_base & (lsel == l)
+                rel_fp = bs + ((offset[:, None] + ks[None, :fpl]) & (bs - 1))
+                content = self._low(inc, l)
+                state = self._send_level(
+                    net,
+                    state,
+                    l,
+                    jnp.repeat(m, fpl),
+                    jnp.repeat(ids, fpl),
+                    (ids[:, None] ^ rel_fp).reshape(-1),
+                    jnp.repeat(content, fpl, axis=0),
+                )
+        return state
+
+    # -- tick phase 2: deliver due channel slots into the candidate buffer ---
+    def _channel_deliver(self, net, state):
+        """onNewSig (Handel.java:752-786): due in-flight slots become
+        verification candidates; the buffer keeps the top-K by
+        (sizeIfIncluded, rank) among survivors of the curation rule."""
+        proto = state.proto
+        t = state.time
+        n, L, D, K = self.n_nodes, self.n_levels, self.CHANNEL_DEPTH, self.CAND_SLOTS
+        ids = jnp.arange(n, dtype=jnp.int32)
+        rel_mask = (1 << self.rel_bits) - 1
+
+        ss = D + 1
+        occupied = (proto["in_key"] >= 0) & (proto["in_key"] != INT32_MAX)
         in_key = jnp.where(
             occupied, proto["in_key"] - (1 << self.rel_bits), proto["in_key"]
-        )  # [N, L, D]
-        due = occupied & ((in_key >> self.rel_bits) <= 0)
-        rel = in_key & ((1 << self.rel_bits) - 1)
-
-        # receiver traffic counters tick for every delivered message
-        # (Network.java:611-612, before onNewSig's own filters)
-        sizes = jnp.asarray(
-            [self.msg_size(l) for l in range(self.n_levels)], jnp.int32
         )
-        dm = due.astype(jnp.int32)
-        state = state._replace(
-            msg_received=state.msg_received + jnp.sum(dm, axis=(1, 2)),
-            bytes_received=state.bytes_received
-            + jnp.sum(dm * sizes[None, :, None], axis=(1, 2)),
+        due_all = occupied & ((in_key >> self.rel_bits) <= 0)
+        empty_tpl = jnp.asarray(
+            np.where(self._fresh_cols(), -1, INT32_MAX), jnp.int32
         )
 
-        started = t >= proto["start_at"][:, None, None]
-        not_done = (state.done_at == 0)[:, None, None]
-        accept = due & started & not_done
-        filtered = jnp.sum((due & ~not_done).astype(jnp.int32), axis=(1, 2))
+        # (receiver traffic counters tick at send time in _send_level)
+        d_by_level = due_all.reshape(n, L - 1, ss)
+        started = t >= proto["start_at"]
+        not_done = state.done_at == 0
+        filtered = jnp.sum((d_by_level & ~not_done[:, None, None]).astype(jnp.int32), axis=(1, 2))
 
-        # candidate priority: fuller content first (the stand-in for the
-        # reference's added-sigs scoring), sender rel as tie-break
-        content_bits = popcount_words(proto["in_sig"]).astype(jnp.int32)  # [N, L, D]
-        half = self.n_nodes // 2
-        prio = half + 1 - jnp.minimum(content_bits, half)
-        key2 = jnp.where(accept, (prio << self.rel_bits) | rel, INT32_MAX)
-        # best due slot per (receiver, level), then fold into the register
-        best_d = jnp.argmin(key2, axis=2)  # [N, L]
-        best_key = jnp.take_along_axis(key2, best_d[:, :, None], axis=2)[:, :, 0]
-        best_sig = jnp.take_along_axis(
-            proto["in_sig"], best_d[:, :, None, None], axis=2
-        )[:, :, 0, :]
-        better = best_key < proto["pend_key"]
+        new_cand_rank = proto["cand_rank"]
+        new_cand_rel = proto["cand_rel"]
+        new_cand_sig = proto["cand_sig"]
+        inc, ind, bl = proto["inc"], proto["ind"], proto["bl"]
+
+        for l in range(1, L):
+            bs = 1 << (l - 1)
+            w = self.w[l]
+            keys = self._key_seg(in_key, l)  # [N, D]
+            due = self._key_seg(due_all, l)
+            rel = keys & rel_mask
+            r0 = rel & (bs - 1)
+
+            # onNewSig drop filters: not started, done, blacklisted sender
+            bl_bit = self._getbit(bl, rel)
+            accept = due & started[:, None] & not_done[:, None] & (bl_bit == 0)
+
+            # shuffle sender-space content into receiver block-local space
+            sig_new = xor_shuffle(self._sig_seg(proto["in_sig"], l, ss), r0)
+
+            # rank + verified-sender demotion (receptionRanks += nodeCount)
+            ind_bit = self._getbit(ind, rel)
+            rank_new = self._base_rank(
+                state.seed, ids[:, None], l, rel
+            ) + self.n_nodes * ind_bit.astype(jnp.int32)
+            rank_new = jnp.where(accept, rank_new, INT32_MAX)
+
+            # merge [K existing + D new], keep top-K by (sizeIfIncluded, -rank)
+            c_rank = proto["cand_rank"][:, (l - 1) * K : l * K]
+            c_rel = proto["cand_rel"][:, (l - 1) * K : l * K]
+            c_sig = self._sig_seg(proto["cand_sig"], l, K)
+
+            all_rank = jnp.concatenate([c_rank, rank_new], axis=1)  # [N, K+D]
+            all_rel = jnp.concatenate([c_rel, rel], axis=1)
+            all_sig = jnp.concatenate([c_sig, sig_new], axis=1)  # [N, K+D, w]
+            valid = all_rank != INT32_MAX
+
+            inc_b = self._blk(inc, l)
+            ind_b = self._blk(ind, l)
+            inter = popcount_words(all_sig & inc_b[:, None, :]) > 0
+            c = jnp.where(inter[..., None], all_sig, all_sig | inc_b[:, None, :])
+            s = popcount_words(c | ind_b[:, None, :])  # sizeIfIncluded
+            cur = popcount_words(inc_b)
+            bl_all = self._getbit(bl, all_rel)
+            keep = valid & (s > cur[:, None]) & (bl_all == 0)
+
+            # sort key: higher sizeIfIncluded first, then lower rank;
+            # bounded (s <= bs <= N/2, rank < 3N) so s*4N + rank fits int32
+            r4 = 4 * self.n_nodes
+            skey = jnp.where(
+                keep, s * r4 + (r4 - 1 - jnp.minimum(all_rank, r4 - 1)), -1
+            )
+            order = jnp.argsort(-skey, axis=1)[:, :K]  # top-K
+            top_keep = jnp.take_along_axis(skey, order, axis=1) >= 0
+            sel_rank = jnp.where(
+                top_keep, jnp.take_along_axis(all_rank, order, axis=1), INT32_MAX
+            )
+            sel_rel = jnp.take_along_axis(all_rel, order, axis=1)
+            sel_sig = jnp.take_along_axis(all_sig, order[..., None], axis=1)
+
+            new_cand_rank = new_cand_rank.at[:, (l - 1) * K : l * K].set(sel_rank)
+            new_cand_rel = new_cand_rel.at[:, (l - 1) * K : l * K].set(sel_rel)
+            o, wk = self.off[l] * K, self.w[l] * K
+            new_cand_sig = new_cand_sig.at[:, o : o + wk].set(
+                sel_sig.reshape(n, wk)
+            )
 
         state = state._replace(
             proto=dict(
                 proto,
-                in_key=jnp.where(due, INT32_MAX, in_key),
-                pend_key=jnp.where(better, best_key, proto["pend_key"]),
-                cand_sig=jnp.where(better[..., None], best_sig, proto["cand_sig"]),
+                in_key=jnp.where(due_all, empty_tpl[None, :], in_key),
+                cand_rank=new_cand_rank,
+                cand_rel=new_cand_rel,
+                cand_sig=new_cand_sig,
                 msg_filtered=proto["msg_filtered"] + filtered,
             )
         )
         return state
 
+    # -- tick phase 3: periodic dissemination --------------------------------
     def _dissemination(self, net, state):
         """Periodic doCycle over open levels (Handel.java:331-343, 452-480)."""
         p = self.params
@@ -230,162 +594,300 @@ class BatchedHandel(BatchedProtocol):
             proto["added_cycle"] - 1,
             proto["added_cycle"],
         )
-
-        masks, dests, types, contents = [], [], [], []
         new_pos = proto["pos"]
+        state = state._replace(proto=dict(proto, added_cycle=new_added))
+
         for l in range(1, self.n_levels):
             bs = 1 << (l - 1)
             opened = t >= (l - 1) * p.level_wait_time
-            complete = self._outgoing_complete(proto["inc"], l)
+            out_b = self._low(state.proto["inc"], l)
+            complete = popcount_words(out_b) == (1 if l == 1 else bs)
             mask = may_send & (opened | complete)
             offset = hash32(state.seed, ids, jnp.int32(l)) & (bs - 1)
             rel = (bs + ((new_pos[:, l] + offset) & (bs - 1))).astype(jnp.int32)
             new_pos = new_pos.at[:, l].set(
                 jnp.where(mask, new_pos[:, l] + 1, new_pos[:, l])
             )
-            masks.append(mask)
-            dests.append(ids ^ rel)
-            types.append(jnp.full(self.n_nodes, l, jnp.int32))
-            contents.append(
-                (proto["inc"] & jnp.asarray(self.low_masks[l]))[:, : self.out_words]
-            )
-        state = state._replace(proto=dict(proto, pos=new_pos, added_cycle=new_added))
-        state = self._send(
-            net,
-            state,
-            jnp.concatenate(masks),
-            jnp.tile(ids, self.n_levels - 1),
-            jnp.concatenate(dests),
-            jnp.concatenate(types),
-            jnp.concatenate(contents, axis=0),
-        )
+            state = self._send_level(net, state, l, mask, ids, ids ^ rel, out_b)
+        state = state._replace(proto=dict(state.proto, pos=new_pos))
         return state
 
-    def _verify(self, net, state):
-        """checkSigs + updateVerifiedSignatures, one verification per free
-        node per tick (capacity = pairingTime serialization)."""
+    # -- tick phase 4: start new verifications (checkSigs) -------------------
+    def _select(self, net, state):
+        """bestToVerify per level + uniform cross-level choice + attacks +
+        window adaptation (Handel.java:566-630, 788-837)."""
         p = self.params
         proto = state.proto
         t = state.time
-        n, L = self.n_nodes, self.n_levels
+        n, L, K = self.n_nodes, self.n_levels, self.CAND_SLOTS
         ids = jnp.arange(n, dtype=jnp.int32)
 
-        keys = proto["pend_key"]  # [N, L]
-        valid = keys < INT32_MAX
-        can = (
-            (proto["busy_until"] <= t)
+        free = (
+            ~proto["ver_active"]
             & ~state.down
             & (t >= proto["start_at"] + 1)
-            & jnp.any(valid, axis=1)
+        )
+        window = proto["window"]
+        inc, ind, agg, bl, byz = (
+            proto["inc"],
+            proto["ind"],
+            proto["agg"],
+            proto["bl"],
+            proto["byz"],
         )
 
-        # chooseBestFromLevels: uniform random among levels with candidates
-        rnd = (hash32(state.seed, t, ids, jnp.int32(0x5EED)).astype(jnp.uint32)
-               >> jnp.uint32(8)).astype(jnp.int32)
-        vcount = jnp.sum(valid, axis=1).astype(jnp.int32)
+        # per-level bests
+        has = []  # level has a candidate to verify
+        b_rank = []  # chosen candidate's rank (for hidden-byz comparison)
+        b_rel = []
+        b_bad = []
+        b_kidx = []  # candidate-buffer slot, -1 = injected
+        b_widx = []  # windowIndex per level (hidden-byz re-run needs it)
+        b_insc = []  # inside-window score of the choice, -1 = outside pick
+        new_cand_rank = proto["cand_rank"]
+        for l in range(1, L):
+            bs = 1 << (l - 1)
+            c_rank = proto["cand_rank"][:, (l - 1) * K : l * K]
+            c_rel = proto["cand_rel"][:, (l - 1) * K : l * K]
+            c_sig = self._sig_seg(proto["cand_sig"], l, K)
+            valid = c_rank != INT32_MAX
+
+            inc_b = self._blk(inc, l)
+            ind_b = self._blk(ind, l)
+            agg_b = self._blk(agg, l)
+
+            # curation (bestToVerify :592-612): drop blacklisted senders and
+            # candidates that can no longer grow the aggregate
+            inter = popcount_words(c_sig & inc_b[:, None, :]) > 0
+            cc = jnp.where(inter[..., None], c_sig, c_sig | inc_b[:, None, :])
+            s = popcount_words(cc | ind_b[:, None, :])
+            bl_bit = self._getbit(bl, c_rel)
+            curated = valid & (s > popcount_words(inc_b)[:, None]) & (bl_bit == 0)
+            # permanent removal, like replaceToVerifyAgg (:612-618)
+            pruned_rank = jnp.where(curated, c_rank, INT32_MAX)
+            new_cand_rank = new_cand_rank.at[:, (l - 1) * K : l * K].set(pruned_rank)
+
+            # windowIndex = min rank over the (pre-curation valid) queue
+            window_index = jnp.min(
+                jnp.where(valid, c_rank, INT32_MAX), axis=1
+            )
+            win_hi = jnp.where(
+                window_index < INT32_MAX - window, window_index + window, INT32_MAX
+            )
+            inside = curated & (c_rank <= win_hi[:, None])
+
+            # score (:650-664)
+            agg_card = popcount_words(agg_b)
+            sig_card = popcount_words(c_sig)
+            agg_inter = popcount_words(c_sig & agg_b[:, None, :]) > 0
+            with_ind = popcount_words(c_sig | ind_b[:, None, :])
+            score = jnp.where(
+                agg_card[:, None] >= bs,
+                0,
+                jnp.where(
+                    ~agg_inter,
+                    agg_card[:, None] + sig_card,
+                    jnp.maximum(0, with_ind - agg_card[:, None]),
+                ),
+            )
+            in_score = jnp.where(inside & (score > 0), score, -1)
+            k_in = jnp.argmax(in_score, axis=1)
+            sc_in = jnp.take_along_axis(in_score, k_in[:, None], axis=1)[:, 0]
+            exists_in = sc_in > 0
+
+            out_rank = jnp.where(curated & ~inside, c_rank, INT32_MAX)
+            k_out = jnp.argmin(out_rank, axis=1)
+            rk_out = jnp.take_along_axis(out_rank, k_out[:, None], axis=1)[:, 0]
+            exists_out = rk_out < INT32_MAX
+
+            kidx = jnp.where(exists_in, k_in, k_out)
+            lrank = jnp.where(
+                exists_in,
+                jnp.take_along_axis(c_rank, k_in[:, None], axis=1)[:, 0],
+                rk_out,
+            )
+            lrel = jnp.take_along_axis(c_rel, kidx[:, None], axis=1)[:, 0]
+            lhas = exists_in | exists_out
+            lbad = jnp.zeros(n, bool)
+
+            if p.byzantine_suicide:
+                # createSuicideByzantineSig (:538-559): a forged full-block
+                # sig from an eligible Byzantine peer short-circuits the
+                # level's choice.  Eligible = down+byz, not blacklisted,
+                # rank inside windowIndex + currWindowSize, queue non-empty.
+                eligible = self._blk(byz, l) & ~self._blk(bl, l)
+                any_valid = jnp.any(valid, axis=1)
+                has_byz = popcount_words(eligible) > 0
+                # lowest block-local index (stand-in for cursor order)
+                m_byz = self._lowest_bit(eligible)
+                rel_byz = bs + (m_byz & (bs - 1))
+                rank_byz = self._base_rank(state.seed, ids, l, rel_byz)
+                inject = (
+                    has_byz
+                    & any_valid
+                    & (rank_byz < win_hi)
+                )
+                lhas = lhas | inject
+                lbad = jnp.where(inject, True, lbad)
+                lrel = jnp.where(inject, rel_byz, lrel)
+                lrank = jnp.where(inject, rank_byz, lrank)
+                kidx = jnp.where(inject, -1, kidx)
+
+            has.append(lhas)
+            b_rank.append(lrank)
+            b_rel.append(lrel)
+            b_bad.append(lbad)
+            b_kidx.append(kidx)
+            b_widx.append(window_index)
+            b_insc.append(jnp.where(exists_in, sc_in, -1))
+
+        has = jnp.stack(has, axis=1)  # [N, L-1]
+        b_rank = jnp.stack(b_rank, axis=1)
+        b_rel = jnp.stack(b_rel, axis=1)
+        b_bad = jnp.stack(b_bad, axis=1)
+        b_kidx = jnp.stack(b_kidx, axis=1)
+
+        # chooseBestFromLevels: uniform among levels with a candidate (:788)
+        vcount = jnp.sum(has, axis=1).astype(jnp.int32)
+        can = free & (vcount > 0)
+        rnd = (
+            hash32(state.seed, t, ids, jnp.int32(0x5EED)).astype(jnp.uint32)
+            >> jnp.uint32(8)
+        ).astype(jnp.int32)
         pick = jnp.where(vcount > 0, lax.rem(rnd, jnp.maximum(vcount, 1)), 0)
-        cum = jnp.cumsum(valid, axis=1)
-        level_sel = jnp.argmax((cum == (pick + 1)[:, None]) & valid, axis=1)
+        cum = jnp.cumsum(has, axis=1)
+        lidx = jnp.argmax((cum == (pick + 1)[:, None]) & has, axis=1)  # 0-based
+        level_sel = (lidx + 1).astype(jnp.int32)
 
-        key_sel = jnp.take_along_axis(keys, level_sel[:, None], axis=1)[:, 0]
-        rel = jnp.where(can, key_sel & ((1 << self.rel_bits) - 1), 0)
+        sel_rank = jnp.take_along_axis(b_rank, lidx[:, None], axis=1)[:, 0]
+        sel_rel = jnp.take_along_axis(b_rel, lidx[:, None], axis=1)[:, 0]
+        sel_bad = jnp.take_along_axis(b_bad, lidx[:, None], axis=1)[:, 0]
+        sel_kidx = jnp.take_along_axis(b_kidx, lidx[:, None], axis=1)[:, 0]
+        sel_single = jnp.zeros(n, bool)  # hidden-byz single-bit sig marker
 
-        # the candidate's exact send-time content, re-addressed into our
-        # space by the xor permutation
-        cand = jnp.take_along_axis(
-            proto["cand_sig"], level_sel[:, None, None], axis=1
-        )[:, 0, :]
-        pad = jnp.zeros((n, self.n_words - self.out_words), jnp.uint32)
-        sig = xor_shuffle(jnp.concatenate([cand, pad], axis=1), rel)
-        lmask = jnp.asarray(self.level_masks)[level_sel]
-        sig = sig & lmask  # safety: stay within the level block
+        if p.hidden_byzantine and L > 1:
+            # HiddenByzantine.attack (:840-917), modeled at selection time:
+            # when the chosen best is at the top level, a valid single-bit
+            # sig from the lowest-index down-byz peer not yet in
+            # totalIncoming is appended and bestToVerify re-runs — the
+            # injected sig wins when it lands inside the (possibly lowered)
+            # window with a strictly higher score than any inside candidate
+            # (appended last, so ties keep the incumbent, :578-584).
+            l = L - 1
+            bs = 1 << (l - 1)
+            inc_b = self._blk(inc, l)
+            ind_b = self._blk(ind, l)
+            agg_b = self._blk(agg, l)
+            eligible = self._blk(byz, l) & ~inc_b
+            has_byz = popcount_words(eligible) > 0
+            m_byz = self._lowest_bit(eligible)
+            rel_byz = bs + (m_byz & (bs - 1))
+            rank_byz = self._base_rank(state.seed, ids, l, rel_byz)
 
-        canw = can[:, None]
-        agg, ind, inc = proto["agg"], proto["ind"], proto["inc"]
+            # its score: single new bit (:650-664)
+            agg_card = popcount_words(agg_b)
+            oh = self._onehot(m_byz & (bs - 1), self.w[l])
+            byz_inter = popcount_words(oh & agg_b) > 0
+            byz_score = jnp.where(
+                agg_card >= bs,
+                0,
+                jnp.where(
+                    ~byz_inter,
+                    agg_card + 1,
+                    jnp.maximum(0, popcount_words(oh | ind_b) - agg_card),
+                ),
+            )
+            widx_top = b_widx[-1]
+            insc_top = b_insc[-1]
+            new_widx = jnp.minimum(widx_top, rank_byz)
+            win_hi = jnp.where(
+                new_widx < INT32_MAX - window, new_widx + window, INT32_MAX
+            )
+            was_outside = insc_top < 0
+            wins = (
+                can
+                & (level_sel == l)
+                & (sel_kidx >= 0)
+                & has_byz
+                & (rank_byz < sel_rank)
+                & (rank_byz <= win_hi)
+                & (byz_score > 0)
+                & (was_outside | (byz_score > insc_top))
+            )
+            sel_rel = jnp.where(wins, rel_byz, sel_rel)
+            sel_rank = jnp.where(wins, rank_byz, sel_rank)
+            sel_kidx = jnp.where(wins, -1, sel_kidx)
+            sel_single = wins
 
-        # verifiedIndSignatures.set(from) — the sender bit
-        one = np.zeros(self.n_words, dtype=np.uint32)
-        one[0] = 1
-        ind_bit = xor_shuffle(jnp.broadcast_to(jnp.asarray(one), (n, self.n_words)), rel)
-        new_ind = jnp.where(canw, ind | ind_bit, ind)
-
-        # lastAgg replace-on-intersect (Handel.java:714-722)
-        agg_l = agg & lmask
-        intersects = popcount_words(agg_l & sig) > 0
-        new_agg_l = jnp.where(intersects[:, None], sig, agg_l | sig)
-        new_agg = jnp.where(canw, (agg & ~lmask) | new_agg_l, agg)
-        new_inc = jnp.where(canw, (new_agg | new_ind), inc)
-
-        was_complete = jnp.stack(
-            [self._incoming_complete(inc, l) for l in range(1, L)], axis=1
+        # window adaptation (:823-825): exp increase on correct, exp
+        # decrease on bad, clamped to [min, max] and the level size
+        grown = jnp.ceil(window.astype(jnp.float32) * p.window_increase_factor)
+        shrunk = jnp.floor(window.astype(jnp.float32) / p.window_decrease_factor)
+        adapted = jnp.where(sel_bad, shrunk, grown).astype(jnp.int32)
+        adapted = jnp.clip(adapted, p.window_minimum, p.window_maximum)
+        lsize = (jnp.uint32(1) << jnp.maximum(level_sel - 1, 0).astype(jnp.uint32)).astype(
+            jnp.int32
         )
-        now_complete = jnp.stack(
-            [self._incoming_complete(new_inc, l) for l in range(1, L)], axis=1
-        )
+        new_window = jnp.where(can, jnp.minimum(adapted, lsize), window)
 
-        new_keys = jnp.where(
-            can[:, None] & (jnp.arange(L)[None, :] == level_sel[:, None]),
-            INT32_MAX,
-            keys,
-        )
-        new_busy = jnp.where(can, t + proto["pairing"], proto["busy_until"])
-        checked = proto["sigs_checked"] + can.astype(jnp.int32)
+        # load the chosen sig into the verification register
+        ver_sig = proto["ver_sig"]
+        for l in range(1, L):
+            bs = 1 << (l - 1)
+            m = can & (level_sel == l)
+            c_sig = self._sig_seg(proto["cand_sig"], l, K)
+            safe_k = jnp.maximum(sel_kidx, 0)
+            from_buf = jnp.take_along_axis(c_sig, safe_k[:, None, None], axis=1)[:, 0]
+            full_block = jnp.full((n, self.w[l]), 0xFFFFFFFF, jnp.uint32)
+            if bs < 32:
+                full_block = jnp.full((n, 1), (1 << bs) - 1, jnp.uint32)
+            single = self._onehot((sel_rel & (bs - 1)), self.w[l])
+            sig_l = jnp.where(
+                (sel_kidx >= 0)[:, None],
+                from_buf,
+                jnp.where(sel_single[:, None], single, full_block),
+            )
+            pad = jnp.zeros((n, self.w_max - self.w[l]), jnp.uint32)
+            sig_l = jnp.concatenate([sig_l, pad], axis=1)
+            ver_sig = jnp.where(m[:, None], sig_l, ver_sig)
 
-        total = popcount_words(new_inc)
-        done_now = (state.done_at == 0) & ~state.down & (total >= p.threshold)
-        new_done_at = jnp.where(done_now, t, state.done_at)
+        # remove the chosen buffer candidate (commit-time removal in the
+        # reference; removal at selection avoids double-verification)
+        flat_idx = (level_sel - 1) * K + jnp.maximum(sel_kidx, 0)
+        remove = can & (sel_kidx >= 0)
+        safe_row = jnp.where(remove, ids, n)
+        new_cand_rank = new_cand_rank.at[safe_row, flat_idx].set(
+            INT32_MAX, mode="drop"
+        )
 
         state = state._replace(
-            done_at=new_done_at,
             proto=dict(
                 proto,
-                agg=new_agg,
-                ind=new_ind,
-                inc=new_inc,
-                pend_key=new_keys,
-                busy_until=new_busy,
-                sigs_checked=checked,
-            ),
+                cand_rank=new_cand_rank,
+                ver_active=jnp.where(can, True, proto["ver_active"]),
+                ver_done_t=jnp.where(
+                    can, t + proto["pairing"], proto["ver_done_t"]
+                ),
+                ver_level=jnp.where(can, level_sel, proto["ver_level"]),
+                ver_rel=jnp.where(can, sel_rel, proto["ver_rel"]),
+                ver_bad=jnp.where(can, sel_bad, proto["ver_bad"]),
+                ver_sig=ver_sig,
+                window=new_window,
+                sigs_checked=proto["sigs_checked"] + can.astype(jnp.int32),
+            )
         )
-
-        # fastPath burst: a just-completed incoming level completes the
-        # outgoing of the next level -> contact fast_path peers of the first
-        # higher level that is still incomplete (Handel.java:738-742)
-        just = can & jnp.any(now_complete & ~was_complete, axis=1)
-        if p.fast_path > 0:
-            out_done = jnp.stack(
-                [self._outgoing_complete(new_inc, l) for l in range(1, L)], axis=1
-            )
-            target_ok = out_done & ~now_complete
-            has_target = jnp.any(target_ok, axis=1)
-            lsel = (jnp.argmax(target_ok, axis=1) + 1).astype(jnp.int32)
-            bs = (1 << (lsel - 1)).astype(jnp.int32)
-            fp_mask = just & has_target
-            fp = min(p.fast_path, max(1, self.n_nodes // 2))
-            offset = hash32(state.seed, ids, lsel, t)
-            ks = jnp.arange(fp, dtype=jnp.int32)
-            rel_fp = (
-                bs[:, None] + ((offset[:, None] + ks[None, :]) & (bs[:, None] - 1))
-            ).astype(jnp.int32)
-            mask_fp = fp_mask[:, None] & (ks[None, :] < bs[:, None])
-            low_sel = jnp.asarray(self.low_masks)[lsel]
-            content = (new_inc & low_sel)[:, : self.out_words]
-            state = self._send(
-                net,
-                state,
-                mask_fp.reshape(-1),
-                jnp.repeat(ids, fp),
-                (ids[:, None] ^ rel_fp).reshape(-1),
-                jnp.repeat(lsel, fp),
-                jnp.repeat(content, fp, axis=0),
-            )
         return state
 
     # -- engine hooks --------------------------------------------------------
     def tick(self, net, state):
+        # deliver FIRST: it decrements every occupied channel key by one
+        # tick, so anything sent later in this tick (fastPath bursts in
+        # _commit, dissemination) is first decremented next tick and lands
+        # exactly at its sampled arrival
         state = self._channel_deliver(net, state)
+        state = self._commit(net, state)
         state = self._dissemination(net, state)
-        state = self._verify(net, state)
+        state = self._select(net, state)
         return state
 
     def all_done(self, state):
@@ -426,14 +928,28 @@ def make_handel(
         1, (params.pairing_time * np.array([nd.speed_ratio for nd in nodes]))
     ).astype(np.int32)
 
+    proto = BatchedHandel(params)
+
+    # Byzantine peers, as each receiver's rel-space bitset (nodes that are
+    # both down and flagged byzantine — Handel.java:957-976 stops them and
+    # the attacks impersonate them)
+    byz_rel = None
+    if params.byzantine_suicide or params.hidden_byzantine:
+        byz_abs = np.zeros(proto.n_words, dtype=np.uint32)
+        for i in sorted(bad):
+            byz_abs[i // 32] |= np.uint32(1 << (i % 32))
+        ids = np.arange(n, dtype=np.int32)
+        byz_rel = np.asarray(
+            xor_shuffle(jnp.broadcast_to(jnp.asarray(byz_abs), (n, proto.n_words)), ids)
+        )
+
     city_index = getattr(latency, "city_index", None)
     cols = build_node_columns(nodes, city_index)
-    proto = BatchedHandel(params)
     net = BatchedNetwork(proto, latency, n, capacity=capacity)
     state = net.init_state(
         cols,
         seed=seed,
-        proto=proto.proto_init(n, pairing, start_at),
+        proto=proto.proto_init(n, pairing, start_at, byz_rel),
         down=down,
     )
     return net, state
