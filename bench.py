@@ -1,6 +1,15 @@
 """Benchmark: batched Handel aggregation throughput vs the oracle DES.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line with at least {"metric", "value", "unit",
+"vs_baseline"}, plus a full diagnosis block so a CPU number can never
+masquerade as a TPU number:
+
+  "platform":      the backend that actually ran ("tpu" / "cpu"),
+  "device_kind":   e.g. "TPU v5 lite",
+  "probe":         every backend-probe attempt (returncode, seconds,
+                   stderr tail) and the fallback reason if any,
+  "config":        node_count / n_replicas / sim_ms actually run,
+  "compile_s", "run_s": wall-clock split.
 
 Flagship config per BASELINE.json: Handel BLS aggregation, 4096 nodes
 (0% Byzantine for the headline number), NetworkLatencyByDistanceWJitter.
@@ -10,42 +19,75 @@ oracle DES (this repo's exact-semantics port of the reference's Java event
 loop) running the identical configuration once; vs_baseline is the
 speedup: batched sims/sec divided by oracle sims/sec.
 
-On non-TPU hosts (CPU smoke runs) the node count and replica count shrink
-so the bench stays fast; the driver's TPU run uses the full 4096."""
+Env knobs:
+  WITT_BENCH_PLATFORM=cpu|tpu  skip the probe, force a platform
+  WITT_BENCH_REPLICAS=N        override the replica count
+  WITT_BENCH_PROFILE=DIR       capture a jax.profiler trace of the timed run
+"""
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 SIM_MS = 1000
+PROBE_ATTEMPTS = 3
+PROBE_TIMEOUT_S = 150
 
 
-def _ensure_backend() -> None:
-    """If the pinned platform can't initialize (e.g. the TPU tunnel is
-    down), fall back to CPU at the jax-config level.  A dead tunnel makes
-    jax.devices() HANG rather than raise (see tests/conftest.py), so the
-    probe runs in a subprocess with a timeout — the parent only touches
-    jax after the verdict."""
-    import subprocess
-    import sys
+def _probe_backend() -> dict:
+    """Decide which platform to run on, WITHOUT touching jax in this
+    process (a dead TPU tunnel makes jax.devices() HANG rather than raise —
+    see tests/conftest.py — so the probe runs in killable subprocesses).
 
-    import jax
+    Returns {"platform", "attempts": [...], "fallback_reason"}."""
+    forced = os.environ.get("WITT_BENCH_PLATFORM")
+    if forced:
+        return {"platform": forced, "attempts": [], "fallback_reason": f"forced by WITT_BENCH_PLATFORM={forced}"}
 
-    try:
-        ok = (
-            subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=90,
+    attempts = []
+    for i in range(PROBE_ATTEMPTS):
+        t0 = time.time()
+        try:
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax; d = jax.devices(); print(d[0].platform, '|', d[0].device_kind)",
+                ],
+                timeout=PROBE_TIMEOUT_S,
                 capture_output=True,
-            ).returncode
-            == 0
-        )
-    except subprocess.TimeoutExpired:
-        ok = False
-    if not ok:
-        jax.config.update("jax_platforms", "cpu")
-    jax.devices()
+                text=True,
+            )
+            rec = {
+                "attempt": i,
+                "rc": r.returncode,
+                "seconds": round(time.time() - t0, 1),
+                "stdout": r.stdout.strip()[-200:],
+                "stderr_tail": r.stderr.strip()[-400:],
+            }
+            attempts.append(rec)
+            if r.returncode == 0 and r.stdout.strip():
+                platform = r.stdout.split("|")[0].strip()
+                return {"platform": platform, "attempts": attempts, "fallback_reason": None}
+        except subprocess.TimeoutExpired:
+            attempts.append(
+                {
+                    "attempt": i,
+                    "rc": None,
+                    "seconds": round(time.time() - t0, 1),
+                    "stderr_tail": f"probe timed out after {PROBE_TIMEOUT_S}s (hung backend init — dead TPU tunnel?)",
+                }
+            )
+        time.sleep(5)
+    return {
+        "platform": "cpu",
+        "attempts": attempts,
+        "fallback_reason": f"all {PROBE_ATTEMPTS} backend probes failed; falling back to CPU",
+    }
 
 
 def _params(node_ct: int):
@@ -75,7 +117,7 @@ def bench_oracle(node_ct: int) -> float:
     return 1.0 / dt
 
 
-def bench_batched(node_ct: int, n_replicas: int) -> float:
+def bench_batched(node_ct: int, n_replicas: int) -> dict:
     import jax
 
     from wittgenstein_tpu.engine import replicate_state
@@ -84,37 +126,94 @@ def bench_batched(node_ct: int, n_replicas: int) -> float:
     net, state = make_handel(_params(node_ct))
     states = replicate_state(state, n_replicas)
     run = jax.jit(lambda s: net.run_ms_batched(s, SIM_MS))
+
+    t0 = time.perf_counter()
     out = run(states)  # compile + warmup
     jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
     assert int(out.done_at.min()) > 0, "sim did not converge"
     assert int(out.dropped.max()) == 0, "message ring overflow"
 
+    profile_dir = os.environ.get("WITT_BENCH_PROFILE")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
     out = run(states)
     jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-    return n_replicas / dt
+    run_s = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
+    return {
+        "sims_per_sec": n_replicas / run_s,
+        "compile_s": round(compile_s, 1),
+        "run_s": round(run_s, 3),
+    }
 
 
 def main() -> None:
-    _ensure_backend()
+    probe = _probe_backend()
+
     import jax
 
-    platform = jax.devices()[0].platform
-    if platform == "tpu":
-        node_ct, n_replicas = 4096, 32
-    else:
-        node_ct, n_replicas = 256, 4
+    if probe["platform"] != "tpu":
+        # the sitecustomize pins jax_platforms=axon; override at the config
+        # level (the env var alone is not enough)
+        jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    platform = devs[0].platform
+    device_kind = getattr(devs[0], "device_kind", "?")
 
-    batched = bench_batched(node_ct, n_replicas)
+    if platform == "tpu":
+        ladder = [(4096, 32), (4096, 16), (4096, 8)]
+    else:
+        ladder = [(256, 4)]
+    if os.environ.get("WITT_BENCH_REPLICAS"):
+        ladder = [(ladder[0][0], int(os.environ["WITT_BENCH_REPLICAS"]))]
+
+    result, bench_error = None, None
+    for node_ct, n_replicas in ladder:
+        try:
+            result = bench_batched(node_ct, n_replicas)
+            break
+        except Exception as e:  # OOM etc: step down the ladder, keep the trace
+            bench_error = f"{node_ct}x{n_replicas}: {type(e).__name__}: {str(e)[:300]}"
+    if result is None:
+        print(
+            json.dumps(
+                {
+                    "metric": "handel_sims_per_sec_chip",
+                    "value": 0.0,
+                    "unit": "sims/sec",
+                    "vs_baseline": 0.0,
+                    "platform": platform,
+                    "device_kind": device_kind,
+                    "probe": probe,
+                    "error": bench_error,
+                }
+            )
+        )
+        return
+
     oracle = bench_oracle(node_ct)
     print(
         json.dumps(
             {
                 "metric": f"handel{node_ct}_sims_per_sec_chip",
-                "value": round(batched, 3),
+                "value": round(result["sims_per_sec"], 3),
                 "unit": "sims/sec",
-                "vs_baseline": round(batched / oracle, 3),
+                "vs_baseline": round(result["sims_per_sec"] / oracle, 3),
+                "platform": platform,
+                "device_kind": device_kind,
+                "config": {
+                    "node_count": node_ct,
+                    "n_replicas": n_replicas,
+                    "sim_ms": SIM_MS,
+                },
+                "compile_s": result["compile_s"],
+                "run_s": result["run_s"],
+                "oracle_sims_per_sec": round(oracle, 4),
+                "probe": probe,
+                "bench_error": bench_error,
             }
         )
     )
