@@ -82,7 +82,8 @@ def _probe_backend() -> dict:
                     "stderr_tail": f"probe timed out after {PROBE_TIMEOUT_S}s (hung backend init — dead TPU tunnel?)",
                 }
             )
-        time.sleep(5)
+        if i < PROBE_ATTEMPTS - 1:
+            time.sleep(5)
     return {
         "platform": "cpu",
         "attempts": attempts,
@@ -137,12 +138,14 @@ def bench_batched(node_ct: int, n_replicas: int) -> dict:
     profile_dir = os.environ.get("WITT_BENCH_PROFILE")
     if profile_dir:
         jax.profiler.start_trace(profile_dir)
-    t0 = time.perf_counter()
-    out = run(states)
-    jax.block_until_ready(out)
-    run_s = time.perf_counter() - t0
-    if profile_dir:
-        jax.profiler.stop_trace()
+    try:
+        t0 = time.perf_counter()
+        out = run(states)
+        jax.block_until_ready(out)
+        run_s = time.perf_counter() - t0
+    finally:
+        if profile_dir:
+            jax.profiler.stop_trace()
     return {
         "sims_per_sec": n_replicas / run_s,
         "compile_s": round(compile_s, 1),
@@ -181,14 +184,14 @@ def main() -> None:
         print(
             json.dumps(
                 {
-                    "metric": "handel_sims_per_sec_chip",
+                    "metric": f"handel{ladder[0][0]}_sims_per_sec_chip",
                     "value": 0.0,
                     "unit": "sims/sec",
                     "vs_baseline": 0.0,
                     "platform": platform,
                     "device_kind": device_kind,
                     "probe": probe,
-                    "error": bench_error,
+                    "bench_error": bench_error,
                 }
             )
         )

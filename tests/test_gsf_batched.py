@@ -1,0 +1,96 @@
+"""Batched GSFSignature: convergence (incl. the 2048-node north-star
+config), quantile-level oracle parity, budgets, batching/determinism."""
+
+import numpy as np
+
+from wittgenstein_tpu.engine import replicate_state
+from wittgenstein_tpu.protocols.gsf import GSFSignature, GSFSignatureParameters
+from wittgenstein_tpu.protocols.gsf_batched import make_gsf
+
+
+def make_params(**kw):
+    base = dict(
+        node_count=64,
+        threshold=int(64 * 0.99),
+        pairing_time=3,
+        timeout_per_level_ms=50,
+        period_duration_ms=10,
+        accelerated_calls_count=10,
+        nodes_down=0,
+    )
+    base.update(kw)
+    return GSFSignatureParameters(**base)
+
+
+def oracle_done_at(params, seeds, run_ms):
+    out = []
+    for seed in seeds:
+        p = GSFSignature(params)
+        p.network().rd.set_seed(seed)
+        p.init()
+        p.network().run_ms(run_ms)
+        out += [n.done_at for n in p.network().live_nodes()]
+    return np.asarray(out)
+
+
+class TestBatchedGSF:
+    def test_converges(self):
+        net, state = make_gsf(make_params())
+        state = net.run_ms(state, 2000)
+        done = np.asarray(state.done_at)
+        assert (done > 0).all()
+        assert bool(net.protocol.all_done(state))
+
+    def test_oracle_quantile_parity(self):
+        """P10/P50/P90 of time-to-threshold within 8% of the oracle DES."""
+        p = make_params()
+        o = oracle_done_at(p, range(12), 2000)
+        assert (o > 0).all()
+        net, state = make_gsf(p)
+        states = replicate_state(state, 16)
+        out = net.run_ms_batched(states, 2000)
+        b = np.asarray(out.done_at).ravel()
+        assert (b > 0).all()
+        oq = np.percentile(o, [10, 50, 90])
+        bq = np.percentile(b, [10, 50, 90])
+        rel = np.abs(bq - oq) / oq
+        assert (rel <= 0.08).all(), (oq, bq, rel)
+
+    def test_dead_nodes(self):
+        p = make_params(nodes_down=16, threshold=40)
+        net, state = make_gsf(p)
+        state = net.run_ms(state, 4000)
+        down = np.asarray(state.down)
+        done = np.asarray(state.done_at)
+        assert down.sum() == 16
+        assert not down[1]  # node 1 kept up (GSFSignature.java:621)
+        assert (done[~down] > 0).all()
+        assert (done[down] == 0).all()
+
+    def test_send_budget_exhausts(self):
+        """remainingCalls caps per-level sends; once every node is done and
+        stops improving, budgets stay exhausted and traffic stops."""
+        net, state = make_gsf(make_params())
+        s1 = net.run_ms(state, 2000)
+        sent1 = np.asarray(s1.msg_sent).sum()
+        s2 = net.run_ms(s1, 1000)
+        sent2 = np.asarray(s2.msg_sent).sum()
+        assert sent2 == sent1, (sent1, sent2)
+
+    def test_replicas_and_determinism(self):
+        net, state = make_gsf(make_params(node_count=32, threshold=30))
+        states = replicate_state(state, 4, seeds=[3, 4, 5, 6])
+        out = net.run_ms_batched(states, 2000)
+        done = np.asarray(out.done_at)
+        assert (done > 0).all()
+        assert len({tuple(done[i]) for i in range(4)}) > 1
+        out2 = net.run_ms_batched(states, 2000)
+        assert (np.asarray(out2.done_at) == done).all()
+
+    def test_north_star_2048(self):
+        """BASELINE.json config #2: GSF gossip aggregation, 2048 nodes."""
+        p = make_params(node_count=2048, threshold=int(2048 * 0.99))
+        net, state = make_gsf(p)
+        state = net.run_ms(state, 800)
+        done = np.asarray(state.done_at)
+        assert (done > 0).all(), (done == 0).sum()

@@ -1,0 +1,231 @@
+"""Shared machinery for batched bitset-aggregation protocols (Handel, GSF).
+
+Both protocols keep per-node contribution bitsets in the XOR-relative
+layout (ops.bitops): bit j of node i's vector is node i^j, level l is the
+static bit block [2^(l-1), 2^l), and re-addressing sender s's level-l
+content into receiver i's space is the bit permutation j -> j ^ r0 with
+r0 = (i^s) & (2^(l-1)-1).
+
+The in-flight message channel is the finite-shape stand-in for the
+oracle's per-ms message queue: per (receiver, level), D arrival-keyed
+slots (earliest arrival wins; slot = arrival mod D) plus one freshest-
+offer backstop slot that is always overwritten by the newest send — so
+when a level's traffic dies out, the last content a laggard was offered
+still delivers instead of being displaced.  Content is stored in SENDER
+bit space at the level's exact word width w_l = max(1, 2^(l-1)/32),
+packed into one flat word axis (W_total = sum w_l) to dodge XLA's (8,128)
+tile padding on small minor dimensions.
+
+Keys pack ((arrival - now) << rel_bits) | rel and are decremented once
+per tick, so the packing never overflows int32 for node counts up to
+MAX_NODES = 2^14; construction fails loudly beyond that.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..engine import BatchedProtocol
+from ..ops.bitops import level_block_mask, popcount_words
+
+INT32_MAX = np.int32(2**31 - 1)
+MAX_NODES = 1 << 14  # int32 key-packing headroom
+
+
+class BitsetAggBase(BatchedProtocol):
+    TICK_INTERVAL = 1  # verification capacity is modeled per-ms
+    PAYLOAD_WIDTH = 0  # messaging bypasses the generic ring entirely
+    CHANNEL_DEPTH = 8  # D: arrival-keyed in-flight slots per (receiver, level)
+
+    def _init_geometry(self, n: int) -> None:
+        if n & (n - 1):
+            raise ValueError("power-of-two node counts only")
+        if n > MAX_NODES:
+            raise NotImplementedError(
+                f"node_count {n} > {MAX_NODES}: int32 channel/sort key packing "
+                "would overflow; widen the keys before raising this cap"
+            )
+        self.n_nodes = n
+        self.n_words = max(1, n // 32)
+        self.n_levels = n.bit_length()  # levels 0..log2(n)
+        self.rel_bits = max(1, (n - 1).bit_length())
+        self.MSG_TYPES = [f"SIGS_L{l}" for l in range(self.n_levels)]
+
+        # per-level content geometry: level l's payload is bits [0, 2^(l-1))
+        # = w_l words at flat offset off_l
+        self.w = [0] * self.n_levels
+        self.off = [0] * self.n_levels
+        acc = 0
+        for l in range(1, self.n_levels):
+            self.w[l] = max(1, (1 << (l - 1)) // 32)
+            self.off[l] = acc
+            acc += self.w[l]
+        self.w_total = acc
+        self.w_max = self.w[self.n_levels - 1] if self.n_levels > 1 else 1
+
+        # static full-width level masks (receiver rel space)
+        self.level_masks = np.stack(
+            [level_block_mask(l, self.n_words) for l in range(self.n_levels)]
+        )
+        low = np.zeros_like(self.level_masks)
+        acc_m = np.zeros(self.n_words, dtype=np.uint32)
+        for l in range(self.n_levels):
+            low[l] = acc_m  # bits below level l's block
+            acc_m = acc_m | self.level_masks[l]
+        self.low_masks = low
+
+    # -- block-local helpers -------------------------------------------------
+    # receiver rel space block [2^(l-1), 2^l) <-> block-local bits [0, 2^(l-1))
+    def _blk(self, x, l: int):
+        """Level-l block of full-width vectors [..., W] -> [..., w_l]."""
+        bs = 1 << (l - 1)
+        if bs >= 32:
+            return x[..., bs // 32 : (2 * bs) // 32]
+        return (x[..., 0:1] >> jnp.uint32(bs)) & jnp.uint32((1 << bs) - 1)
+
+    def _blk_write(self, x, l: int, blk, where):
+        """Write block-local [..., w_l] back into full-width [..., W]."""
+        bs = 1 << (l - 1)
+        if bs >= 32:
+            new = jnp.where(where[..., None], blk, x[..., bs // 32 : (2 * bs) // 32])
+            return x.at[..., bs // 32 : (2 * bs) // 32].set(new)
+        m = jnp.uint32(((1 << bs) - 1) << bs)
+        w0 = (x[..., 0] & ~m) | ((blk[..., 0] << jnp.uint32(bs)) & m)
+        return x.at[..., 0].set(jnp.where(where, w0, x[..., 0]))
+
+    def _low(self, x, l: int):
+        """Sender-space outgoing content at level l: bits [0, 2^(l-1))."""
+        bs = 1 << (l - 1)
+        if bs >= 32:
+            return x[..., : bs // 32]
+        return x[..., 0:1] & jnp.uint32((1 << bs) - 1)
+
+    @staticmethod
+    def _onehot(r0, w: int):
+        """Block-local one-hot bit r0: [...] int32 -> [..., w] uint32."""
+        word = r0 >> 5
+        bit = (r0 & 31).astype(jnp.uint32)
+        return jnp.where(
+            jnp.arange(w, dtype=jnp.int32) == word[..., None],
+            (jnp.uint32(1) << bit)[..., None],
+            jnp.uint32(0),
+        )
+
+    @staticmethod
+    def _lowest_bit(words):
+        """Index of the lowest set bit of packed [N, w] uint32 vectors
+        (undefined when empty — gate on popcount > 0)."""
+        word_nz = words != 0
+        widx = jnp.argmax(word_nz, axis=1).astype(jnp.int32)
+        wval = jnp.take_along_axis(words, widx[:, None], axis=1)[:, 0]
+        lowbit = popcount_words(((wval & (-wval).astype(jnp.uint32)) - 1)[:, None])
+        return widx * 32 + lowbit
+
+    def _getbit(self, x, pos):
+        """Bit `pos` of full-width [N, W] vectors; pos is [N, ...] int32."""
+        word = jnp.take_along_axis(
+            x, (pos >> 5).reshape(pos.shape[0], -1), axis=1
+        ).reshape(pos.shape)
+        return (word >> (pos & 31).astype(jnp.uint32)) & jnp.uint32(1)
+
+    # -- channel layout ------------------------------------------------------
+    def _fresh_cols(self) -> np.ndarray:
+        """bool[(L-1)*(D+1)]: which in_key columns are fresh-backstop slots."""
+        ss = self.CHANNEL_DEPTH + 1
+        cols = np.zeros((self.n_levels - 1) * ss, dtype=bool)
+        cols[ss - 1 :: ss] = True
+        return cols
+
+    def _key_seg(self, in_key, l: int):
+        ss = self.CHANNEL_DEPTH + 1
+        return in_key[:, (l - 1) * ss : l * ss]
+
+    def _sig_seg(self, sig_flat, l: int, slots: int):
+        n = sig_flat.shape[0]
+        o, w = self.off[l] * slots, self.w[l] * slots
+        return sig_flat[:, o : o + w].reshape(n, slots, self.w[l])
+
+    def _channel_init(self, n: int):
+        """Fresh in_key / in_sig arrays (fresh slots empty at -1, arrival
+        slots at INT32_MAX)."""
+        d = self.CHANNEL_DEPTH
+        in_key = np.where(self._fresh_cols(), -1, INT32_MAX).astype(np.int32)
+        return (
+            jnp.asarray(np.broadcast_to(in_key, (n, in_key.size)).copy()),
+            jnp.zeros((n, (d + 1) * self.w_total), jnp.uint32),
+        )
+
+    def _advance_channel(self, in_key):
+        """Decrement occupied keys one tick; returns (in_key, due, empty_tpl)."""
+        occupied = (in_key >= 0) & (in_key != INT32_MAX)
+        in_key = jnp.where(occupied, in_key - (1 << self.rel_bits), in_key)
+        due = occupied & ((in_key >> self.rel_bits) <= 0)
+        empty_tpl = jnp.asarray(
+            np.where(self._fresh_cols(), -1, INT32_MAX), jnp.int32
+        )
+        return in_key, due, empty_tpl
+
+    # -- send path -----------------------------------------------------------
+    def _send_level(self, net, state, l: int, mask, from_idx, to_idx, content, aux=None):
+        """Send K messages at level l into the per-(receiver, slot) channel;
+        earliest arrival wins an arrival slot, the newest offer always takes
+        the fresh slot.  Content is sender-space [K, w_l]; `aux` is an
+        optional [K] int32 side value stored per slot in proto["in_aux"]."""
+        proto = state.proto
+        d = self.CHANNEL_DEPTH
+        state, ok, arrival = net.latency_arrivals(
+            state, mask, from_idx, to_idx, state.time + 1, jnp.int32(l)
+        )
+        # receiver traffic counters tick here, at send time: every ok send
+        # is delivered by the oracle (Network.java:611-612), but the channel
+        # may displace it — counting at send keeps end-of-run totals exact
+        # at the cost of counters leading arrivals by the latency
+        okc = ok.astype(jnp.int32)
+        state = state._replace(
+            msg_received=state.msg_received.at[to_idx].add(okc, mode="drop"),
+            bytes_received=state.bytes_received.at[to_idx].add(
+                okc * self.msg_size(l), mode="drop"
+            ),
+        )
+        rel = (to_idx ^ from_idx).astype(jnp.int32)
+        # time-relative arrival (>= 1): decremented per tick, so the packing
+        # never overflows int32
+        rel_arr = arrival - state.time
+        key = jnp.where(ok, (rel_arr << self.rel_bits) | rel, INT32_MAX)
+        ss = d + 1
+
+        slot = lax.rem(arrival, jnp.int32(d))
+        col = (l - 1) * ss + slot
+        safe_to = jnp.where(ok, to_idx, self.n_nodes)
+        new_key = proto["in_key"].at[safe_to, col].min(key, mode="drop")
+        winner = ok & (new_key[to_idx, col] == key)
+
+        # freshest-offer backstop (empty at -1 so any real key wins the max)
+        fcol = (l - 1) * ss + d
+        new_key = new_key.at[safe_to, fcol].max(jnp.where(ok, key, -1), mode="drop")
+        fresh_win = ok & (new_key[to_idx, fcol] == key)
+
+        win_to = jnp.where(winner, to_idx, self.n_nodes)
+        wcols = (ss * self.off[l] + slot[:, None] * self.w[l]) + jnp.arange(
+            self.w[l], dtype=jnp.int32
+        )
+        new_sig = proto["in_sig"].at[win_to[:, None], wcols].set(
+            content.astype(jnp.uint32), mode="drop"
+        )
+        fwin_to = jnp.where(fresh_win, to_idx, self.n_nodes)
+        fwcols = (ss * self.off[l] + d * self.w[l]) + jnp.arange(
+            self.w[l], dtype=jnp.int32
+        )
+        new_sig = new_sig.at[fwin_to[:, None], fwcols[None, :]].set(
+            content.astype(jnp.uint32), mode="drop"
+        )
+        updates = dict(proto, in_key=new_key, in_sig=new_sig)
+        if aux is not None:
+            new_aux = proto["in_aux"].at[win_to, col].set(
+                aux.astype(jnp.int32), mode="drop"
+            )
+            new_aux = new_aux.at[fwin_to, fcol].set(aux.astype(jnp.int32), mode="drop")
+            updates["in_aux"] = new_aux
+        return state._replace(proto=updates)

@@ -1,0 +1,585 @@
+"""Batched GSFSignature: north-star config #2 on the TPU engine.
+
+Re-expression of protocols/GSFSignature.java (via the oracle port
+protocols/gsf.py) on the shared bitset-aggregation machinery
+(_agg_batched.BitsetAggBase): XOR-relative packed bitsets, per-level
+exact-width channel slots + freshest-offer backstop, and a one-slot
+verification register committing at t + pairingTime.
+
+GSF specifics vs Handel:
+
+  * a node's level-l sends carry its whole *completed prefix* — the union
+    of consecutively complete levels is always the interval [0, 2^k) in
+    the XOR layout (getLastFinishedLevel, GSFSignature.java:376-392), so
+    the multi-level payload is transmitted as the level-confined content
+    (w_l words) plus ONE integer k per message (`in_aux`/`cand_pk`); the
+    receiver reconstructs the interval exactly, which is what drives the
+    absorb-lower-levels path of updateVerifiedSignatures (:397-411).
+  * level sends are budgeted: remainingCalls starts at the level size and
+    is reset on improvement (:345-356, :438-443); dissemination stops
+    when the budget is exhausted rather than cycling forever.
+  * verification candidates are scored with evaluateSig (:478-520):
+    completion bonus 1_000_000 - 10*level, otherwise 100_000 - 100*level
+    + addedSigs, individual-sig fallback score 1 — and the *global* best
+    across levels is verified (no per-level uniform choice, :524-558).
+  * every first message from a sender enqueues that sender's individual
+    single-bit signature as a separate verification candidate
+    (onNewSig, :560-577), tracked here as pending/seen bitsets with the
+    lowest-index pending bit as the level's representative candidate.
+  * accelerated calls: on improvement, burst the completed prefix to
+    acceleratedCallsCount fresh peers of each level the prefix now covers
+    (:438-451).
+  * no Byzantine attack modes, no desynchronized start, no blacklist
+    (nodes can only be down); done nodes keep verifying their queues.
+
+Distribution-parity approximations (as in batched Handel): counter-hash
+emission order instead of the shuffled peer lists, channel displacement
+instead of an unbounded queue (top-K score-curated candidates), send-time
+receiver counters, simultaneous same-ms deliveries.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.node import Node, build_node_columns
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..engine import BatchedNetwork
+from ..engine.rng import hash32
+from ..ops.bitops import block_mask, popcount_words, xor_shuffle
+from ..utils.javarand import JavaRandom
+from ._agg_batched import INT32_MAX, BitsetAggBase
+from .gsf import GSFSignatureParameters
+
+
+class BatchedGSF(BitsetAggBase):
+    CAND_SLOTS = 8  # K: score-curated verification candidates per level
+
+    def __init__(self, params: GSFSignatureParameters):
+        self.params = params
+        self._init_geometry(params.node_count)
+        # prefix interval masks: pref_masks[k] = bits [0, 2^k)
+        self.pref_masks = np.stack(
+            [block_mask(0, 1 << k, self.n_words) for k in range(self.n_levels)]
+        )
+
+    def msg_size(self, mtype: int) -> int:
+        # Size = level byte + bit field + the aggregated sig + our own sig
+        # (SendSigs, GSFSignature.java:143-164)
+        expected = 1 if mtype == 0 else 1 << (mtype - 1)
+        return 1 + expected // 8 + 96
+
+    # -- state ---------------------------------------------------------------
+    def proto_init(self, n_nodes: int, pairing: np.ndarray):
+        n, L, K = self.n_nodes, self.n_levels, self.CAND_SLOTS
+        own = np.zeros((n, self.n_words), dtype=np.uint32)
+        own[:, 0] = 1  # bit 0 = own signature (level 0)
+        in_key, in_sig = self._channel_init(n)
+        ss = self.CHANNEL_DEPTH + 1
+        remaining = np.zeros((n, L), dtype=np.int32)
+        for l in range(1, L):
+            remaining[:, l] = 1 << (l - 1)
+        return {
+            "ver": jnp.asarray(own),  # verified union, per level blocks
+            "indiv": jnp.zeros((n, self.n_words), jnp.uint32),
+            "ind_seen": jnp.zeros((n, self.n_words), jnp.uint32),
+            "pend_ind": jnp.zeros((n, self.n_words), jnp.uint32),
+            "in_key": in_key,
+            "in_sig": in_sig,
+            "in_aux": jnp.zeros((n, (L - 1) * ss), jnp.int32),  # prefix k
+            "cand_key": jnp.full((n, (L - 1) * K), INT32_MAX, jnp.int32),  # rel
+            "cand_pk": jnp.zeros((n, (L - 1) * K), jnp.int32),
+            "cand_sig": jnp.zeros((n, K * self.w_total), jnp.uint32),
+            "ver_active": jnp.zeros(n, bool),
+            "ver_done_t": jnp.zeros(n, jnp.int32),
+            "ver_level": jnp.zeros(n, jnp.int32),
+            "ver_rel": jnp.zeros(n, jnp.int32),
+            "ver_pk": jnp.zeros(n, jnp.int32),
+            "ver_single": jnp.zeros(n, bool),  # individual-sig verification
+            "ver_sig": jnp.zeros((n, self.w_max), jnp.uint32),
+            "remaining": jnp.asarray(remaining),
+            "pos": jnp.zeros((n, L), jnp.int32),
+            "sig_checked": jnp.zeros(n, jnp.int32),
+            "pairing": jnp.asarray(pairing, jnp.int32),
+        }
+
+    # -- helpers -------------------------------------------------------------
+    def _prefix_k(self, ver):
+        """Number of consecutively complete levels from level 1 up
+        (getLastFinishedLevel): the verified union is then >= [0, 2^k)."""
+        if self.n_levels == 1:
+            return jnp.zeros(ver.shape[0], jnp.int32)
+        comp = jnp.stack(
+            [
+                popcount_words(self._blk(ver, l)) == (1 << (l - 1))
+                for l in range(1, self.n_levels)
+            ],
+            axis=1,
+        )
+        return jnp.sum(jnp.cumprod(comp.astype(jnp.int32), axis=1), axis=1)
+
+    def _eval_sig(self, l: int, sig, ver_b, indiv_b):
+        """evaluateSig (GSFSignature.java:478-520) on block-local [N, K, w]
+        candidates; sig may be [N, w] too (broadcast over K)."""
+        bs = 1 << (l - 1)
+        if sig.ndim == ver_b.ndim:
+            sig = sig[:, None, :]
+        vb = ver_b[:, None, :]
+        ib = indiv_b[:, None, :]
+        ver_card = popcount_words(ver_b)[:, None]
+        sig_card = popcount_words(sig)
+        inter = popcount_words(sig & vb) > 0
+        with_ind = sig | ib
+        with_ind_v = with_ind | vb
+        new_total = jnp.where(
+            ver_card == 0,
+            sig_card,
+            jnp.where(inter, popcount_words(with_ind), popcount_words(with_ind_v)),
+        )
+        added = jnp.where(ver_card == 0, sig_card, new_total - ver_card)
+        indiv_fallback = (
+            (sig_card == 1) & (popcount_words(sig & ib) == 0)
+        ).astype(jnp.int32)
+        score = jnp.where(
+            added <= 0,
+            indiv_fallback,
+            jnp.where(
+                new_total == bs,
+                1_000_000 - l * 10,
+                100_000 - l * 100 + added,
+            ),
+        )
+        return jnp.where(ver_card >= bs, 0, score)
+
+    # -- tick phase 1: commit due verifications ------------------------------
+    def _commit(self, net, state):
+        """updateVerifiedSignatures (GSFSignature.java:379-460)."""
+        p = self.params
+        proto = state.proto
+        t = state.time
+        n, L = self.n_nodes, self.n_levels
+        ids = jnp.arange(n, dtype=jnp.int32)
+
+        due = proto["ver_active"] & (t >= proto["ver_done_t"])
+        ver, indiv = proto["ver"], proto["indiv"]
+        remaining = proto["remaining"]
+        rel = proto["ver_rel"]
+        pk = proto["ver_pk"]
+
+        improved_any = jnp.zeros(n, bool)
+        for l in range(1, L):
+            bs = 1 << (l - 1)
+            m = due & (proto["ver_level"] == l)
+            r0 = rel & (bs - 1)
+            sig_b = proto["ver_sig"][:, : self.w[l]]
+            ver_b = self._blk(ver, l)
+            indiv_b = self._blk(indiv, l)
+
+            # individual sig: set the indiv bit first (:383-385)
+            single = m & proto["ver_single"]
+            oh = self._onehot(r0, self.w[l])
+            new_indiv_b = jnp.where(single[:, None], indiv_b | oh, indiv_b)
+            # holder.sigs |= indivVerifiedSig (:386)
+            sigs = sig_b | new_indiv_b
+
+            # absorb the completed prefix (:397-411): pk >= l means the
+            # sender's consecutive-complete levels cover [0, 2^pk), which
+            # includes this block and the receiver's levels 1..pk
+            absorb = m & (pk >= l)
+            interval = jnp.asarray(self.pref_masks)[jnp.minimum(pk, L - 1)]
+            newly = popcount_words(interval & ~ver) > 0
+            reset_r = absorb & newly
+            ver = jnp.where(absorb[:, None], ver | interval, ver)
+            ver_b = self._blk(ver, l)  # may now be complete
+            full_block = jnp.full((n, 1), (1 << bs) - 1, jnp.uint32) if bs < 32 else jnp.full(
+                (n, self.w[l]), 0xFFFFFFFF, jnp.uint32
+            )
+            sigs = jnp.where(absorb[:, None], full_block, sigs)
+
+            # disjoint sets aggregate (:413-417)
+            disjoint = (popcount_words(ver_b) > 0) & (
+                popcount_words(sigs & ver_b) == 0
+            )
+            sigs = jnp.where((m & disjoint)[:, None], sigs | ver_b, sigs)
+
+            # replacement on improvement (:419-431)
+            improve = m & (
+                (popcount_words(sigs) > popcount_words(ver_b)) | reset_r
+            )
+            ver = self._blk_write(ver, l, sigs, improve)
+            indiv = self._blk_write(indiv, l, new_indiv_b, m)
+
+            # reset send budgets for levels >= l (:421-423)
+            lv_idx = jnp.arange(L, dtype=jnp.int32)[None, :]
+            sizes = jnp.asarray(
+                [0] + [1 << (j - 1) for j in range(1, L)], jnp.int32
+            )[None, :]
+            remaining = jnp.where(
+                improve[:, None] & (lv_idx >= l), sizes, remaining
+            )
+            improved_any = improved_any | improve
+
+        # accelerated calls (:438-451): after the merges, burst the
+        # completed prefix to fresh peers of each level it now covers.
+        # Each node committed at exactly one level (ver_level), so one
+        # send per target level mm covers every row: burst at mm iff the
+        # commit improved, mm > committed level, and the new prefix k
+        # reaches mm-1.
+        state = state._replace(
+            proto=dict(proto, ver=ver, indiv=indiv, remaining=remaining)
+        )
+        if p.accelerated_calls_count > 0 and L > 2:
+            k_new = self._prefix_k(ver)
+            lvl = proto["ver_level"]
+            acc = p.accelerated_calls_count
+            havings = ver | jnp.asarray(self.pref_masks)[jnp.minimum(k_new, L - 1)]
+            for mm in range(2, L):
+                bsm = 1 << (mm - 1)
+                fan = min(acc, bsm)
+                proto_c = state.proto
+                remaining = proto_c["remaining"]
+                burst = improved_any & (lvl < mm) & (k_new >= mm - 1)
+                take = jnp.where(
+                    burst, jnp.minimum(jnp.maximum(remaining[:, mm], 0), fan), 0
+                )
+                offset = hash32(state.seed, ids, jnp.int32(mm), t) & (bsm - 1)
+                js = jnp.arange(fan, dtype=jnp.int32)
+                relb = bsm + (
+                    (proto_c["pos"][:, mm, None] + offset[:, None] + js[None, :])
+                    & (bsm - 1)
+                )
+                mask_b = js[None, :] < take[:, None]
+                state = state._replace(
+                    proto=dict(
+                        proto_c, remaining=remaining.at[:, mm].add(-take)
+                    )
+                )
+                content = self._low(havings, mm)
+                state = self._send_level(
+                    net,
+                    state,
+                    mm,
+                    mask_b.reshape(-1),
+                    jnp.repeat(ids, fan),
+                    (ids[:, None] ^ relb).reshape(-1),
+                    jnp.repeat(content, fan, axis=0),
+                    aux=jnp.repeat(k_new, fan),
+                )
+        proto = state.proto
+        ver, indiv, remaining = proto["ver"], proto["indiv"], proto["remaining"]
+
+        total = popcount_words(ver)
+        done_now = (
+            improved_any & (state.done_at == 0) & ~state.down & (total >= p.threshold)
+        )
+        state = state._replace(
+            done_at=jnp.where(done_now, t, state.done_at),
+            proto=dict(
+                proto,
+                ver=ver,
+                indiv=indiv,
+                remaining=remaining,
+                ver_active=proto["ver_active"] & ~due,
+            ),
+        )
+        return state
+
+    # -- tick phase 2: deliver channel slots into candidates -----------------
+    def _channel_deliver(self, net, state):
+        """onNewSig (GSFSignature.java:560-577): enqueue the aggregate and,
+        once per sender, its individual signature."""
+        proto = state.proto
+        n, L, K = self.n_nodes, self.n_levels, self.CAND_SLOTS
+        rel_mask = (1 << self.rel_bits) - 1
+
+        in_key, due_all, empty_tpl = self._advance_channel(proto["in_key"])
+
+        new_cand_key = proto["cand_key"]
+        new_cand_pk = proto["cand_pk"]
+        new_cand_sig = proto["cand_sig"]
+        new_pend = proto["pend_ind"]
+        new_seen = proto["ind_seen"]
+        ver, indiv = proto["ver"], proto["indiv"]
+
+        for l in range(1, L):
+            bs = 1 << (l - 1)
+            ss = self.CHANNEL_DEPTH + 1
+            keys = self._key_seg(in_key, l)
+            due = self._key_seg(due_all, l)
+            rel = keys & rel_mask
+            r0 = rel & (bs - 1)
+            pk_new = self._key_seg(proto["in_aux"], l)
+
+            sig_new = xor_shuffle(self._sig_seg(proto["in_sig"], l, ss), r0)
+
+            # individual sig enqueue: once per sender per level (the bit
+            # position in rel space IS the level block)
+            oh_rows = jnp.zeros((n, self.n_words), jnp.uint32)
+            for d in range(ss):
+                reld = rel[:, d]
+                hot = self._onehot(reld, self.n_words)
+                oh_rows = oh_rows | jnp.where(due[:, d, None], hot, 0)
+            fresh_ind = oh_rows & ~new_seen
+            new_seen = new_seen | fresh_ind
+            new_pend = new_pend | fresh_ind
+
+            # merge [K existing + ss new] candidates, keep top-K by score
+            c_key = proto["cand_key"][:, (l - 1) * K : l * K]
+            c_pk = proto["cand_pk"][:, (l - 1) * K : l * K]
+            c_sig = self._sig_seg(proto["cand_sig"], l, K)
+
+            all_key = jnp.concatenate(
+                [c_key, jnp.where(due, rel, INT32_MAX)], axis=1
+            )
+            all_pk = jnp.concatenate([c_pk, pk_new], axis=1)
+            all_sig = jnp.concatenate([c_sig, sig_new], axis=1)
+            valid = all_key != INT32_MAX
+
+            ver_b = self._blk(ver, l)
+            indiv_b = self._blk(indiv, l)
+            # prefix-carrying candidates are full-block in this level, so
+            # the exact evaluateSig on block content scores them correctly
+            score = self._eval_sig(l, all_sig, ver_b, indiv_b)
+            score = jnp.where(valid, score, -1)
+            # drop worthless entries (checkSigs' iterator remove, :532-537)
+            score = jnp.where(score == 0, -1, score)
+
+            order = jnp.argsort(-score, axis=1)[:, :K]
+            top_ok = jnp.take_along_axis(score, order, axis=1) > 0
+            sel_key = jnp.where(
+                top_ok, jnp.take_along_axis(all_key, order, axis=1), INT32_MAX
+            )
+            sel_pk = jnp.take_along_axis(all_pk, order, axis=1)
+            sel_sig = jnp.take_along_axis(all_sig, order[..., None], axis=1)
+
+            new_cand_key = new_cand_key.at[:, (l - 1) * K : l * K].set(sel_key)
+            new_cand_pk = new_cand_pk.at[:, (l - 1) * K : l * K].set(sel_pk)
+            o, wk = self.off[l] * K, self.w[l] * K
+            new_cand_sig = new_cand_sig.at[:, o : o + wk].set(
+                sel_sig.reshape(n, wk)
+            )
+
+        state = state._replace(
+            proto=dict(
+                proto,
+                in_key=jnp.where(due_all, empty_tpl[None, :], in_key),
+                cand_key=new_cand_key,
+                cand_pk=new_cand_pk,
+                cand_sig=new_cand_sig,
+                pend_ind=new_pend,
+                ind_seen=new_seen,
+            )
+        )
+        return state
+
+    # -- tick phase 3: periodic dissemination --------------------------------
+    def _dissemination(self, net, state):
+        """doCycle over started levels with send budgets
+        (GSFSignature.java:289-343)."""
+        p = self.params
+        proto = state.proto
+        t = state.time
+        ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
+
+        on_beat = (t >= 1) & (
+            lax.rem(t - 1, jnp.int32(p.period_duration_ms)) == 0
+        )
+        may_send = on_beat & ~state.down
+
+        k = self._prefix_k(proto["ver"])
+        havings = proto["ver"] | jnp.asarray(self.pref_masks)[
+            jnp.minimum(k, self.n_levels - 1)
+        ]
+        new_pos = proto["pos"]
+        new_remaining = proto["remaining"]
+        for l in range(1, self.n_levels):
+            bs = 1 << (l - 1)
+            content = self._low(havings, l)
+            started = (t >= l * p.timeout_per_level_ms) | (
+                popcount_words(content) >= bs
+            )
+            mask = may_send & started & (new_remaining[:, l] > 0)
+            offset = hash32(state.seed, ids, jnp.int32(l)) & (bs - 1)
+            rel = (bs + ((new_pos[:, l] + offset) & (bs - 1))).astype(jnp.int32)
+            new_pos = new_pos.at[:, l].set(
+                jnp.where(mask, new_pos[:, l] + 1, new_pos[:, l])
+            )
+            new_remaining = new_remaining.at[:, l].add(-mask.astype(jnp.int32))
+            state = state._replace(
+                proto=dict(state.proto, pos=new_pos, remaining=new_remaining)
+            )
+            state = self._send_level(
+                net, state, l, mask, ids, ids ^ rel, content, aux=k
+            )
+            new_pos = state.proto["pos"]
+            new_remaining = state.proto["remaining"]
+        return state
+
+    # -- tick phase 4: start verifications (checkSigs) -----------------------
+    def _select(self, net, state):
+        """Global best-scored candidate across levels
+        (GSFSignature.java:524-558)."""
+        proto = state.proto
+        t = state.time
+        n, L, K = self.n_nodes, self.n_levels, self.CAND_SLOTS
+        ids = jnp.arange(n, dtype=jnp.int32)
+
+        free = ~proto["ver_active"] & ~state.down & (t >= 1)
+        ver, indiv, pend = proto["ver"], proto["indiv"], proto["pend_ind"]
+
+        best_score = jnp.zeros(n, jnp.int32)
+        best_level = jnp.zeros(n, jnp.int32)
+        best_rel = jnp.zeros(n, jnp.int32)
+        best_pk = jnp.zeros(n, jnp.int32)
+        best_kidx = jnp.full(n, -1, jnp.int32)  # -1 = individual pending bit
+        new_cand_key = proto["cand_key"]
+        for l in range(1, L):
+            bs = 1 << (l - 1)
+            c_key = proto["cand_key"][:, (l - 1) * K : l * K]
+            c_pk = proto["cand_pk"][:, (l - 1) * K : l * K]
+            c_sig = self._sig_seg(proto["cand_sig"], l, K)
+            valid = c_key != INT32_MAX
+            ver_b = self._blk(ver, l)
+            indiv_b = self._blk(indiv, l)
+            score = jnp.where(valid, self._eval_sig(l, c_sig, ver_b, indiv_b), -1)
+            # curation: drop worthless entries permanently
+            new_cand_key = new_cand_key.at[:, (l - 1) * K : l * K].set(
+                jnp.where(score == 0, INT32_MAX, c_key)
+            )
+            kbest = jnp.argmax(score, axis=1)
+            sbest = jnp.take_along_axis(score, kbest[:, None], axis=1)[:, 0]
+
+            # individual pending representative: lowest pending bit
+            pend_b = self._blk(pend, l)
+            has_pend = popcount_words(pend_b) > 0
+            m_ind = self._lowest_bit(pend_b)
+            oh = self._onehot(m_ind & (bs - 1), self.w[l])
+            s_ind = self._eval_sig(l, oh[:, None, :], ver_b, indiv_b)[:, 0]
+            s_ind = jnp.where(has_pend, s_ind, -1)
+            # worthless individuals are dropped too
+            pend = self._blk_write(
+                pend, l, jnp.where((s_ind == 0)[:, None], pend_b & ~oh, pend_b),
+                has_pend & (s_ind == 0),
+            )
+
+            use_ind = s_ind > sbest
+            l_score = jnp.maximum(sbest, s_ind)
+            l_rel = jnp.where(
+                use_ind,
+                bs + (m_ind & (bs - 1)),
+                jnp.take_along_axis(c_key, kbest[:, None], axis=1)[:, 0],
+            )
+            l_pk = jnp.where(
+                use_ind, 0, jnp.take_along_axis(c_pk, kbest[:, None], axis=1)[:, 0]
+            )
+            l_kidx = jnp.where(use_ind, -1, kbest)
+
+            better = l_score > best_score
+            best_score = jnp.where(better, l_score, best_score)
+            best_level = jnp.where(better, l, best_level)
+            best_rel = jnp.where(better, l_rel, best_rel)
+            best_pk = jnp.where(better, l_pk, best_pk)
+            best_kidx = jnp.where(better, l_kidx, best_kidx)
+
+        can = free & (best_score > 0)
+        sel_single = best_kidx < 0
+
+        # load the chosen sig into the verification register
+        ver_sig = proto["ver_sig"]
+        for l in range(1, L):
+            bs = 1 << (l - 1)
+            m = can & (best_level == l)
+            c_sig = self._sig_seg(proto["cand_sig"], l, K)
+            safe_k = jnp.maximum(best_kidx, 0)
+            from_buf = jnp.take_along_axis(c_sig, safe_k[:, None, None], axis=1)[:, 0]
+            single = self._onehot(best_rel & (bs - 1), self.w[l])
+            sig_l = jnp.where(sel_single[:, None], single, from_buf)
+            pad = jnp.zeros((n, self.w_max - self.w[l]), jnp.uint32)
+            ver_sig = jnp.where(
+                m[:, None], jnp.concatenate([sig_l, pad], axis=1), ver_sig
+            )
+            # clear the individual pending bit on selection
+            pend_b = self._blk(pend, l)
+            oh = self._onehot(best_rel & (bs - 1), self.w[l])
+            pend = self._blk_write(pend, l, pend_b & ~oh, m & sel_single)
+
+        # remove the chosen buffer candidate
+        flat_idx = (best_level - 1) * K + jnp.maximum(best_kidx, 0)
+        remove = can & ~sel_single
+        safe_row = jnp.where(remove, ids, n)
+        new_cand_key = new_cand_key.at[safe_row, flat_idx].set(
+            INT32_MAX, mode="drop"
+        )
+
+        state = state._replace(
+            proto=dict(
+                proto,
+                cand_key=new_cand_key,
+                pend_ind=pend,
+                ver_active=jnp.where(can, True, proto["ver_active"]),
+                ver_done_t=jnp.where(can, t + proto["pairing"], proto["ver_done_t"]),
+                ver_level=jnp.where(can, best_level, proto["ver_level"]),
+                ver_rel=jnp.where(can, best_rel, proto["ver_rel"]),
+                ver_pk=jnp.where(can, best_pk, proto["ver_pk"]),
+                ver_single=jnp.where(can, sel_single, proto["ver_single"]),
+                ver_sig=ver_sig,
+                sig_checked=proto["sig_checked"] + can.astype(jnp.int32),
+            )
+        )
+        return state
+
+    # -- engine hooks --------------------------------------------------------
+    def tick(self, net, state):
+        state = self._channel_deliver(net, state)
+        state = self._commit(net, state)
+        state = self._dissemination(net, state)
+        state = self._select(net, state)
+        return state
+
+    def all_done(self, state):
+        live = ~state.down
+        return jnp.all(jnp.where(live, state.done_at > 0, True))
+
+
+def make_gsf(
+    params: Optional[GSFSignatureParameters] = None,
+    capacity: int = 8,  # generic ring unused by this protocol
+    seed: int = 0,
+):
+    """Host-side construction mirroring GSFSignature.init (gsf.py:init):
+    same JavaRandom stream for node building and the down-node draw."""
+    params = params or GSFSignatureParameters()
+    n = params.node_count
+    nb = registry_node_builders.get_by_name(params.node_builder_name)
+    latency = registry_network_latencies.get_by_name(params.network_latency_name)
+    rd = JavaRandom(0)
+
+    nodes = [Node(rd, nb) for _ in range(n)]
+    down = np.zeros(n, dtype=bool)
+    set_down = 0
+    while set_down < params.nodes_down:
+        i = rd.next_int(n)
+        if not down[i] and i != 1:
+            # node 1 kept up to help debugging (GSFSignature.java:621)
+            down[i] = True
+            set_down += 1
+
+    pairing = np.maximum(
+        1, (params.pairing_time * np.array([nd.speed_ratio for nd in nodes]))
+    ).astype(np.int32)
+
+    proto = BatchedGSF(params)
+    city_index = getattr(latency, "city_index", None)
+    cols = build_node_columns(nodes, city_index)
+    net = BatchedNetwork(proto, latency, n, capacity=capacity)
+    state = net.init_state(
+        cols,
+        seed=seed,
+        proto=proto.proto_init(n, pairing),
+        down=down,
+    )
+    return net, state
