@@ -1,0 +1,314 @@
+"""Batched ETHPoW: Bernoulli mining on the TPU, the blockchain family's
+entry to the batched path.
+
+Re-expression of protocols/ethpow/ETHPoW.java + ETHMiner.java (via the
+oracle port protocols/ethpow.py) as a 10 ms-stepped `lax.while_loop` over
+a preallocated block table — the SURVEY §7 step-7 design:
+
+  * block table `[B]` per replica: parent idx, height, producer,
+    proposal time, difficulty, total difficulty (relative to genesis),
+    plus a dense arrival matrix `[B, M]` (one row scattered per mined
+    block: producer at t, everyone else at t+1+latency — send_all,
+    ETHMiner.java:152-163).
+  * mining is one Bernoulli trial per miner per 10 ms beat
+    (mine10ms, ETHMiner.java:118-129) with success probability
+    1 - (1 - 1/difficulty)^(hashPower*2^30/100) (solveIn10ms,
+    ETHMiner.java:225-231), computed as 1 - exp(-hp/difficulty): the
+    per-hash probability ~5e-16 underflows float32, the exponential form
+    is exact to O(n*p^2) ~ 1e-16.
+  * fork choice by total difficulty with prefer-own-block on ties
+    (ETHPoW.java:299-310, ETHMiner best :337-348) — an argmax over the
+    arrived blocks per miner per beat; on exact ties the own block wins,
+    otherwise the lowest block index (earliest created) stands in for the
+    oracle's keep-first-seen order.
+  * Constantinople difficulty (ETHPoW.java:284-296) from the mainnet
+    genesis (height 7_951_081, difficulty 1_949_482_043_446_410 —
+    ETHPoW.java:158-164), so the EIP-1234 bomb term is the live 2^27
+    branch exactly as in the oracle.
+  * a new head (own or received) restarts mining on it with a fresh
+    candidate stamped at the restart beat (startNewMining,
+    ETHMiner.java:133-141) — same next-beat timing as the oracle's
+    in_mining=None + next mine10ms.
+
+Deliberate simplifications (the spike's documented scope — see
+docs/batched_blockchain_design.md for the fork-choice design note and the
+Casper/Dfinity plan):
+
+  * honest miners only (selfish/agent strategies stay on the oracle);
+  * no uncles: possibleUncles is a bounded DAG walk the batched table
+    can do, but the spike keeps y=1 in the difficulty formula and skips
+    uncle rewards — block-interval dynamics are uncle-independent at the
+    reference's own default (0 uncles until forks are common);
+  * difficulty/total difficulty in float32, total difficulty stored
+    RELATIVE to genesis so ~1e18 accumulations keep ~2^-24 relative
+    precision (the absolute mainnet genesis td 1.06e22 would eat one
+    whole block difficulty per float32 ulp);
+  * same-beat arrivals are processed simultaneously; 10 ms quantization
+    of arrivals (vs the oracle's 1 ms) is negligible against ~13 s block
+    intervals.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.latency import LatencyStatic, vec_latency
+from ..core.node import Node, build_node_columns
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..engine.rng import hash32, pseudo_delta, uniform_u01
+from ..utils.javarand import JavaRandom
+from .ethpow import ETHPoWParameters
+
+INT32_MAX = np.int32(2**31 - 1)
+GENESIS_DIFFICULTY = 1_949_482_043_446_410.0
+GENESIS_HEIGHT = 7_951_081  # mainnet block (ETHPoW.java:158-164)
+TOTAL_HASH_POWER_GHS = 200 * 1024  # ETHPoW.java:72
+BEAT_MS = 10
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class EthPowState:
+    """One replica's simulation state (a pytree)."""
+
+    time: jnp.ndarray  # int32 scalar
+    seed: jnp.ndarray  # int32 scalar
+    # block table
+    n_blocks: jnp.ndarray  # int32 scalar (slot 0 = genesis)
+    parent: jnp.ndarray  # int32[B]
+    height: jnp.ndarray  # int32[B]
+    producer: jnp.ndarray  # int32[B], -1 = genesis
+    b_time: jnp.ndarray  # int32[B] proposal time (mining start)
+    diff: jnp.ndarray  # float32[B]
+    td: jnp.ndarray  # float32[B], relative to genesis
+    arrival: jnp.ndarray  # int32[B, M]
+    overflowed: jnp.ndarray  # int32 scalar: blocks lost to a full table
+    # per-miner state
+    head: jnp.ndarray  # int32[M]
+    father: jnp.ndarray  # int32[M] (mining candidate's parent)
+    cand_time: jnp.ndarray  # int32[M]
+    cand_diff: jnp.ndarray  # float32[M]
+    mining: jnp.ndarray  # bool[M]
+    blocks_mined: jnp.ndarray  # int32[M]
+
+    def tree_flatten(self):
+        return (
+            tuple(getattr(self, f.name) for f in dataclasses.fields(self)),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+class BatchedEthPow:
+    """The jittable simulation: binds the miner population + latency model
+    to a 10 ms-stepped transition over EthPowState."""
+
+    def __init__(
+        self,
+        params: Optional[ETHPoWParameters] = None,
+        b_max: int = 512,
+        seed: int = 0,
+    ):
+        params = params or ETHPoWParameters()
+        if params.byz_class_name:
+            raise NotImplementedError(
+                "batched ETHPoW is the honest-miner spike; Byzantine miner "
+                "strategies run on the oracle (protocols/ethpow.py)"
+            )
+        self.params = params
+        self.b_max = b_max
+        self.m = params.number_of_miners
+        nb = registry_node_builders.get_by_name(params.node_builder_name)
+        self.latency = registry_network_latencies.get_by_name(
+            params.network_latency_name
+        )
+        rd = JavaRandom(seed)
+        nodes = [Node(rd, nb) for _ in range(self.m)]
+        city_index = getattr(self.latency, "city_index", None)
+        self.cols = build_node_columns(nodes, city_index)
+        self.static = LatencyStatic.from_columns(self.cols)
+        # even split of the network hash power (ETHPoW.java:70-87, honest)
+        hp = TOTAL_HASH_POWER_GHS // self.m
+        # P(success per 10 ms) = 1 - exp(-hashes_per_10ms / difficulty)
+        self.hp_per_10ms = float(hp) * (1024.0**3) / 100.0
+
+    # -- state ---------------------------------------------------------------
+    def init_state(self, seed: int = 0) -> EthPowState:
+        b, m = self.b_max, self.m
+        zi = lambda shape: jnp.zeros(shape, jnp.int32)
+        arrival = jnp.full((b, m), INT32_MAX, jnp.int32)
+        arrival = arrival.at[0].set(0)  # genesis known to everyone at t=0
+        return EthPowState(
+            time=jnp.int32(1),
+            seed=jnp.int32(seed),
+            n_blocks=jnp.int32(1),
+            parent=zi(b),
+            height=jnp.full(b, GENESIS_HEIGHT, jnp.int32),
+            producer=jnp.full(b, -1, jnp.int32),
+            b_time=zi(b),
+            diff=jnp.full(b, GENESIS_DIFFICULTY, jnp.float32),
+            td=jnp.zeros(b, jnp.float32),
+            arrival=arrival,
+            overflowed=jnp.int32(0),
+            head=zi(m),
+            father=zi(m),
+            cand_time=zi(m),
+            cand_diff=jnp.full(m, GENESIS_DIFFICULTY, jnp.float32),
+            mining=jnp.zeros(m, bool),
+            blocks_mined=zi(m),
+        )
+
+    # -- difficulty (ETHPoW.java:284-296; low-height bomb quirk kept) --------
+    def _calc_difficulty(self, f_diff, f_time, f_height, ts):
+        gap = ((ts - f_time) // 9000).astype(jnp.float32)
+        ugap = jnp.maximum(-99.0, 1.0 - gap)  # y = 1: no uncles in the spike
+        diff = (f_diff / 2048.0) * ugap
+        periods = (f_height - 4_999_999) // 100_000
+        bomb = jnp.where(
+            periods > 1,
+            jnp.exp2((periods - 2).astype(jnp.float32)),
+            diff,  # the reference's own low-height behavior
+        )
+        return f_diff + diff + bomb
+
+    # -- one 10 ms beat ------------------------------------------------------
+    def _beat(self, s: EthPowState) -> EthPowState:
+        t = s.time
+        m, b = self.m, self.b_max
+        mids = jnp.arange(m, dtype=jnp.int32)
+
+        # 1. fork choice over arrived blocks (ETHMiner.onBlock + best):
+        # max total difficulty; exact ties prefer the own block, else the
+        # earliest-created (lowest index)
+        arrived = s.arrival <= t  # [B, M]
+        td_m = jnp.where(arrived, s.td[:, None], -jnp.inf)
+        mx = jnp.max(td_m, axis=0)  # [M]
+        is_max = td_m == mx[None, :]
+        own = s.producer[:, None] == mids[None, :]
+        own_max = is_max & own
+        has_own = jnp.any(own_max, axis=0)
+        first_any = jnp.argmax(is_max, axis=0).astype(jnp.int32)
+        first_own = jnp.argmax(own_max, axis=0).astype(jnp.int32)
+        new_head = jnp.where(has_own, first_own, first_any)
+
+        # 2. head change (or no candidate yet) restarts mining on the head
+        # with a fresh candidate stamped now (startNewMining)
+        restart = (new_head != s.head) | ~s.mining
+        father = jnp.where(restart, new_head, s.father)
+        cand_time = jnp.where(restart, t, s.cand_time)
+        cand_diff = jnp.where(
+            restart,
+            self._calc_difficulty(
+                s.diff[new_head], s.b_time[new_head], s.height[new_head], t
+            ),
+            s.cand_diff,
+        )
+
+        # 3. one Bernoulli trial per miner (mine10ms)
+        thresh = 1.0 - jnp.exp(-self.hp_per_10ms / cand_diff)
+        u = uniform_u01(s.seed, t, mids, jnp.int32(0xE70))
+        success = u < thresh
+
+        # 4. append found blocks to the table (capacity-guarded)
+        rank = jnp.cumsum(success.astype(jnp.int32)) - 1
+        idx = s.n_blocks + rank
+        fits = success & (idx < b)
+        slot = jnp.where(fits, idx, b)  # OOB -> dropped
+        new_diff_v = cand_diff
+        new_td = s.td[father] + new_diff_v
+        parent = s.parent.at[slot].set(father, mode="drop")
+        height = s.height.at[slot].set(s.height[father] + 1, mode="drop")
+        producer = s.producer.at[slot].set(mids, mode="drop")
+        b_time = s.b_time.at[slot].set(cand_time, mode="drop")
+        diff = s.diff.at[slot].set(new_diff_v, mode="drop")
+        td = s.td.at[slot].set(new_td, mode="drop")
+
+        # arrivals: producer immediately; everyone else at t+1+latency
+        # (sendBlock -> sendAll, ETHMiner.java:152-163)
+        static = self.static
+        from_idx = jnp.repeat(mids, m)  # [M*M]: each miner to every dest
+        to_idx = jnp.tile(mids, m)
+        ev_seed = hash32(s.seed, t, from_idx, jnp.int32(0xB10C))
+        delta = pseudo_delta(to_idx, ev_seed)
+        lat = vec_latency(self.latency, static, from_idx, to_idx, delta)
+        arr = (t + 1 + lat).reshape(m, m)
+        arr = jnp.where(jnp.eye(m, dtype=bool), t, arr)  # own block now
+        arrival = s.arrival.at[slot].set(arr, mode="drop")
+
+        n_ok = jnp.sum(fits.astype(jnp.int32))
+        lost = jnp.sum((success & ~fits).astype(jnp.int32))
+
+        return EthPowState(
+            time=t + BEAT_MS,
+            seed=s.seed,
+            n_blocks=s.n_blocks + n_ok,
+            parent=parent,
+            height=height,
+            producer=producer,
+            b_time=b_time,
+            diff=diff,
+            td=td,
+            arrival=arrival,
+            overflowed=s.overflowed + lost,
+            head=new_head,
+            father=father,
+            cand_time=cand_time,
+            cand_diff=cand_diff,
+            # a successful miner stops (in_mining = None) and restarts on
+            # its own block next beat, exactly like the oracle
+            mining=~success,
+            blocks_mined=s.blocks_mined + success.astype(jnp.int32),
+        )
+
+    # -- run -----------------------------------------------------------------
+    @functools.partial(jax.jit, static_argnums=(0, 2))
+    def run_ms(self, state: EthPowState, ms: int) -> EthPowState:
+        end = state.time + ms
+
+        def cond(s):
+            return s.time < end
+
+        return lax.while_loop(cond, self._beat, state)
+
+    @functools.partial(jax.jit, static_argnums=(0, 2))
+    def run_ms_batched(self, states: EthPowState, ms: int) -> EthPowState:
+        return jax.vmap(lambda s: self.run_ms(s, ms))(states)
+
+
+def replicate_ethpow(state: EthPowState, n_replicas: int, seeds=None) -> EthPowState:
+    if seeds is None:
+        seeds = np.arange(n_replicas, dtype=np.int32)
+    seeds = jnp.asarray(seeds, jnp.int32)
+    tiled = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a, (n_replicas,) + a.shape), state
+    )
+    return dataclasses.replace(tiled, seed=seeds)
+
+
+def chain_intervals(state: EthPowState, replica: Optional[int] = None) -> np.ndarray:
+    """Host-side: proposal-time gaps along the winning chain (the batched
+    analog of walking observer.head.parent — BlockChainNode.java:28-44)."""
+    if replica is not None:
+        state = jax.tree_util.tree_map(lambda a: a[replica], state)
+    td = np.asarray(state.td)
+    n = int(state.n_blocks)
+    parent = np.asarray(state.parent)
+    b_time = np.asarray(state.b_time)
+    cur = int(np.argmax(td[:n]))
+    times = []
+    while cur != 0:
+        times.append(int(b_time[cur]))
+        cur = int(parent[cur])
+    times.append(0)
+    times.reverse()
+    return np.diff(np.asarray(times))
