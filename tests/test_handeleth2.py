@@ -1,0 +1,205 @@
+"""HandelEth2 conformance tests, ported from
+protocols/src/test/java/.../handeleth2/HandelEth2Test.java (190 LoC):
+tree structure, multi-height merge, simple/long runs, dead nodes."""
+
+import random
+
+from wittgenstein_tpu.protocols.handeleth2 import (
+    PERIOD_AGG_TIME,
+    PERIOD_TIME,
+    Attestation,
+    HandelEth2,
+    HandelEth2Parameters,
+    SendAggregation,
+)
+from wittgenstein_tpu.utils.bitset import cardinality as card
+
+
+class TestHandelEth2:
+    def test_tree(self):
+        """HandelEth2Test.testTree (:12-31)."""
+        params = HandelEth2Parameters()
+        p = HandelEth2(params)
+        p.init()
+
+        r = random.Random(7)
+        for _ in range(100):
+            n1 = p.network().get_node_by_id(r.randrange(params.node_count))
+            n2 = p.network().get_node_by_id(r.randrange(params.node_count))
+            if n1 is not n2:
+                c1 = n1.communication_level(n2)
+                assert c1 == n2.communication_level(n1)
+                assert (n1.peers_up_to_level(c1) >> n2.node_id) & 1
+                for l in range(1, c1):
+                    assert not (n1.peers_up_to_level(l) >> n2.node_id) & 1
+
+    def test_merge(self):
+        """HandelEth2Test.testMerge (:33-118)."""
+        params = HandelEth2Parameters(
+            node_count=4,
+            pairing_time=10,
+            level_wait_time=0,
+            period_duration_ms=10,
+            nodes_down=0,
+        )
+        p = HandelEth2(params)
+        p.init()
+        n0 = p.network().get_node_by_id(0)
+        n1 = p.network().get_node_by_id(1)
+
+        base = n0.height + 1
+        H = 5
+        a0 = Attestation(base, H, n0.node_id)
+        a1 = Attestation(base, H, n1.node_id)
+        n0.start_new_aggregation(a0)
+        n1.start_new_aggregation(a1)
+
+        assert n0.height == base
+        assert len(n0.running_aggs) == 1
+
+        ap1 = n1.running_aggs[base]
+        ap0 = n0.running_aggs[base]
+        ap1.update_all_outgoing()
+
+        h11 = ap1.levels[1]
+        assert h11.peers_count == 1
+        assert h11.is_open(0)
+        assert not h11.is_incoming_complete()
+        assert h11.is_outgoing_complete()
+        assert h11.outgoing_cardinality == 1
+        assert h11.incoming_cardinality == 0
+        assert len(h11.outgoing) == 1
+
+        h12 = ap1.levels[2]
+        assert h12.peers_count == 2
+        assert h12.is_open(0)
+        assert not h12.is_incoming_complete()
+        assert not h12.is_outgoing_complete()
+        assert h12.outgoing_cardinality == 1
+        assert h12.incoming_cardinality == 0
+        assert len(h12.outgoing) == 1
+
+        sa = SendAggregation(1, a1.hash, False, a1)
+
+        h01 = ap0.levels[1]
+        assert not h01.to_verify_agg
+        n0.on_new_agg(n1, sa)
+        assert len(h01.to_verify_agg) == 1
+
+        atv = h01.best_to_verify(10, n0.blacklist)
+        assert atv is not None
+        assert atv.height == base
+        assert atv.from_id == n1.node_id
+        assert atv.own_hash == a1.hash
+        assert len(atv.attestations) == 1
+
+        n0.verify()
+        assert n0.last_verified is ap0
+        assert not h01.is_incoming_complete()
+        ap0.update_verified_signatures(atv)
+        ap0.update_all_outgoing()
+
+        assert h01.peers_count == 1
+        assert h01.is_open(0)
+        assert h01.is_incoming_complete()
+        assert h01.is_outgoing_complete()
+        assert h01.outgoing_cardinality == 1
+        assert h01.incoming_cardinality == 1
+        assert len(h01.outgoing) == 1
+
+        h02 = ap0.levels[2]
+        assert h02.peers_count == 2
+        assert h02.is_open(0)
+        assert not h02.is_incoming_complete()
+        assert h02.is_outgoing_complete()
+        assert h02.outgoing_cardinality == 2
+        assert h02.incoming_cardinality == 0
+        assert len(h02.outgoing) == 1
+        assert (h02.outgoing[H].who >> n0.node_id) & 1
+        assert (h02.outgoing[H].who >> n1.node_id) & 1
+        assert card(h02.outgoing[H].who) == 2
+
+        atv_n = h01.best_to_verify(10, n0.blacklist)
+        assert atv_n is None
+        assert not h01.to_verify_agg
+
+    def test_run_simple(self):
+        """HandelEth2Test.testRunSimple (:121-141)."""
+        params = HandelEth2Parameters(
+            node_count=64,
+            pairing_time=10,
+            level_wait_time=100,
+            period_duration_ms=40,
+            nodes_down=0,
+        )
+        p = HandelEth2(params)
+        p.init()
+        n = p.network().get_node_by_id(0)
+
+        assert n.cur_windows_size == 16
+
+        p.network().run_ms(PERIOD_TIME - 500)
+
+        assert n.cur_windows_size == 128
+        assert len(n.running_aggs) == 1
+
+        ap = n.running_aggs.get(1001)
+        assert ap is not None
+        for hl in ap.levels:
+            assert hl.is_incoming_complete(), f"n0, {hl}"
+
+    def test_run(self):
+        """HandelEth2Test.testRun (:143-162)."""
+        params = HandelEth2Parameters(
+            node_count=64,
+            pairing_time=10,
+            level_wait_time=100,
+            period_duration_ms=40,
+            nodes_down=0,
+        )
+        p = HandelEth2(params)
+        p.init()
+        n = p.network().get_node_by_id(0)
+
+        p.network().run_ms(PERIOD_AGG_TIME * 10)
+
+        assert len(n.running_aggs) == 3
+
+        min_running = min(n.running_aggs.keys())
+        ap = n.running_aggs[min_running]
+        for hl in ap.levels:
+            assert hl.is_incoming_complete(), f"n0, {hl}"
+
+    def test_run_with_dead_nodes(self):
+        """HandelEth2Test.testRunWithDeadNodes (:164-189)."""
+        params = HandelEth2Parameters(
+            node_count=128,
+            pairing_time=5,
+            level_wait_time=200,
+            period_duration_ms=40,
+            nodes_down=5,
+        )
+        p = HandelEth2(params)
+        p.init()
+        n = p.network().get_first_live_node()
+
+        p.network().run_ms(PERIOD_AGG_TIME * 10)
+
+        min_running = min(n.running_aggs.keys())
+        ap = n.running_aggs[min_running]
+        hl = ap.levels[-1]
+
+        # with dead nodes the last level can't be complete
+        assert not hl.is_incoming_complete(), f"n0, {hl}"
+
+        # but we have time to get every live contribution
+        assert ap.get_best_result_size() == params.node_count - params.nodes_down
+
+        all_attestations = 0
+        for a in ap.get_best_result().values():
+            all_attestations |= a.who
+        assert card(all_attestations) == params.node_count - params.nodes_down
+        dead = 0
+        for i in p.network().get_dead_nodes():
+            dead |= 1 << i
+        assert not (all_attestations & dead)

@@ -8,10 +8,13 @@ wittgenstein_tpu.core.params.protocol_registry (the API-discovery contract).
 """
 
 from . import (  # noqa: F401
+    casper,
+    dfinity,
     enr_gossiping,
     ethpow,
     gsf,
     handel,
+    handeleth2,
     optimistic_p2p_signature,
     p2pflood,
     p2phandel,
@@ -24,10 +27,13 @@ from . import (  # noqa: F401
 )
 
 __all__ = [
+    "casper",
+    "dfinity",
     "enr_gossiping",
     "ethpow",
     "gsf",
     "handel",
+    "handeleth2",
     "optimistic_p2p_signature",
     "p2pflood",
     "p2phandel",
