@@ -1,0 +1,149 @@
+"""Serve-layer tests: the WServerTest-style every-protocol API sweep
+(reference ws/WServerTest.java:65-122) plus endpoint flows over real HTTP
+(stdlib client against the stdlib server on an ephemeral port)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from wittgenstein_tpu.server import WServer, serve
+
+
+@pytest.fixture(scope="module")
+def base_url():
+    httpd = serve(0)
+    port = httpd.server_address[1]
+    yield f"http://127.0.0.1:{port}"
+    httpd.shutdown()
+
+
+def get(base, path):
+    with urllib.request.urlopen(base + path, timeout=60) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def post(base, path, payload=None, method="POST"):
+    data = (
+        payload.encode()
+        if isinstance(payload, str)
+        else json.dumps(payload).encode()
+        if payload is not None
+        else b""
+    )
+    req = urllib.request.Request(
+        base + path, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+class TestWServer:
+    def test_protocol_list(self, base_url):
+        status, ps = get(base_url, "/w/protocols")
+        assert status == 200
+        assert "PingPong" in ps
+        assert len(ps) == 16  # every reference protocol family
+
+    def test_all_protocols_api_sweep(self, base_url):
+        """WServerTest.testBasicAllProtocols (:65-122): for EVERY registered
+        protocol, fetch default params, re-post them to init, and check the
+        nodes and messages endpoints respond."""
+        _, ps = get(base_url, "/w/protocols")
+        for p in ps:
+            status, params = get(base_url, f"/w/protocols/{p}")
+            assert status == 200, p
+            assert params["type"].endswith("Parameters"), p
+
+            status, _ = post(base_url, f"/w/network/init/{p}", params)
+            assert status == 200, p
+
+            status, nodes = get(base_url, "/w/network/nodes")
+            assert status == 200, p
+            assert len(nodes) > 0, p
+
+            status, _ = get(base_url, "/w/network/messages")
+            assert status == 200, p
+
+    def test_run_and_inspect_flow(self, base_url):
+        _, params = get(base_url, "/w/protocols/PingPong")
+        params["node_ct"] = 100
+        assert post(base_url, "/w/network/init/PingPong", params)[0] == 200
+
+        status, out = post(base_url, "/w/network/runMs/200")
+        assert status == 200 and out["time"] == 200
+        assert get(base_url, "/w/network/time")[1] == 200
+
+        _, n0 = get(base_url, "/w/network/nodes/0")
+        assert n0["nodeId"] == 0
+        assert n0["msgReceived"] > 0  # pongs arrived at the witness
+
+        # stop/start (note the reference's own path asymmetry)
+        assert post(base_url, "/w/network/nodes/5/stop")[0] == 200
+        assert get(base_url, "/w/network/nodes/5")[1]["down"] is True
+        assert post(base_url, "/w/nodes/5/start")[0] == 200
+        assert get(base_url, "/w/network/nodes/5")[1]["down"] is False
+
+    def test_message_injection(self, base_url):
+        _, params = get(base_url, "/w/protocols/PingPong")
+        params["node_ct"] = 50
+        post(base_url, "/w/network/init/PingPong", params)
+        status, _ = post(
+            base_url,
+            "/w/network/send",
+            {
+                "from": 3,
+                "to": [1, 2],
+                "sendTime": 1,
+                "delayBetweenSend": 0,
+                "message": {"type": "Ping"},
+            },
+        )
+        assert status == 200
+        _, msgs = get(base_url, "/w/network/messages")
+        assert any(m["msg"] == "Ping" and m["from"] == 3 for m in msgs)
+        # deliver them: receivers answer with pongs
+        post(base_url, "/w/network/runMs/1000")
+        _, n3 = get(base_url, "/w/network/nodes/3")
+        assert n3["msgReceived"] >= 2
+
+    def test_external_sink_and_mock(self, base_url):
+        _, params = get(base_url, "/w/protocols/PingPong")
+        params["node_ct"] = 20
+        post(base_url, "/w/network/init/PingPong", params)
+        # the demo sink accepts an EnvelopeInfo and returns no sends
+        status, out = post(base_url, "/w/external_sink", {"x": 1}, method="PUT")
+        assert status == 200 and out == []
+        # attach the local mock External to a node: sim keeps working
+        assert post(base_url, "/w/network/nodes/2/external", "mock")[0] == 200
+        _, n2 = get(base_url, "/w/network/nodes/2")
+        assert n2["external"] == "ExternalMockImplementation"
+        assert post(base_url, "/w/network/runMs/300")[0] == 200
+
+    def test_sweep_endpoint(self, base_url):
+        status, out = post(
+            base_url,
+            "/w/sweep",
+            {
+                "protocol": "Handel",
+                "params": {},
+                "runs": 2,
+                "maxTime": 10_000,
+                "stats": ["doneAt", "msgReceived"],
+                "untilDone": True,
+            },
+        )
+        assert status == 200
+        assert out["runs"] == 2
+        assert len(out["stats"]) == 2
+        assert out["stats"][0]["max"] > 0
+
+    def test_errors(self, base_url):
+        assert post(base_url, "/w/network/init/NoSuchProtocol")[0] == 400
+        assert get(base_url, "/w/protocols")[0] == 200
+        status, _ = post(base_url, "/w/unknown/route")
+        assert status == 404

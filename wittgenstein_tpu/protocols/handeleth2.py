@@ -448,21 +448,22 @@ class HNode(Node):
         if self.last_verified is None:
             self.last_verified = next(iter(self.running_aggs.values()))
 
-        for _ in range(len(self.running_aggs)):
-            ap = self.running_aggs.get(self.last_verified.height + 1)
-            if ap is None:
-                ap = self.running_aggs[min(self.running_aggs.keys())]
-            sa = ap.best_to_verify()
-            if sa is not None:
-                self.last_verified = ap
-                tv = ap
-                self.handel_eth2.network().register_task(
-                    lambda: tv.update_verified_signatures(sa),
-                    # -1: update before the verification loop runs again
-                    self.handel_eth2.network().time + self.node_pairing_time - 1,
-                    self,
-                )
-                break
+        # the reference iterates runningAggs.size() times, but lastVerified
+        # only moves on success, so every iteration resolves the SAME
+        # process (HNode.java:268-287) — one scan is observably identical
+        ap = self.running_aggs.get(self.last_verified.height + 1)
+        if ap is None:
+            ap = self.running_aggs[min(self.running_aggs.keys())]
+        sa = ap.best_to_verify()
+        if sa is not None:
+            self.last_verified = ap
+            tv = ap
+            self.handel_eth2.network().register_task(
+                lambda: tv.update_verified_signatures(sa),
+                # -1: update before the verification loop runs again
+                self.handel_eth2.network().time + self.node_pairing_time - 1,
+                self,
+            )
 
     def start_new_aggregation(self, base: Optional[Attestation] = None) -> None:
         if base is None:

@@ -1,0 +1,31 @@
+"""The serve layer (L5): remote control of simulations over HTTP.
+
+The reference's wserver module (IServer.java:10-34, Server.java:20-173,
+ws/WServer.java:22-114) is a Spring Boot REST app; this package is the
+same contract on the standard library only (http.server) — no web
+framework is available in the image, and none is needed:
+
+  * `Server` — the IServer implementation over the explicit protocol
+    registry (the reference uses classpath reflection scanning,
+    Server.java:57-70; our registry is the same contract made explicit).
+  * `WServer`/`serve` — the HTTP mapping of every /w/** endpoint,
+    plus a batch-sweep job endpoint (POST /w/sweep) that exposes the
+    RunMultipleTimes multi-seed runner remotely — the `wserver` growth
+    axis named in BASELINE.json.
+  * `ExternalRest` / `ExternalMockImplementation` — the client-side
+    External counterparts (server/ExternalRest.java:20-60,
+    ExternalMockImplementation.java:13-42): a node's message handling
+    delegated to a remote HTTP service, or to a local logging mock.
+"""
+
+from .external import ExternalMockImplementation, ExternalRest
+from .server import Server
+from .ws import WServer, serve
+
+__all__ = [
+    "ExternalMockImplementation",
+    "ExternalRest",
+    "Server",
+    "WServer",
+    "serve",
+]

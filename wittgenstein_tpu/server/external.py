@@ -1,0 +1,78 @@
+"""Client-side External implementations.
+
+An `External` lets a node's message handling be delegated outside the
+simulator (core External.java:7-10; engine hook at Network.java:616-623 —
+oracle/network.py's delivery loop): `receive(EnvelopeInfo) ->
+List[SendMessage]`.
+
+  * ExternalRest (reference server/ExternalRest.java:20-60): serializes
+    the EnvelopeInfo to JSON, PUTs it to a remote service, deserializes
+    the returned list of SendMessages.
+  * ExternalMockImplementation (ExternalMockImplementation.java:13-42):
+    local mock — logs, then executes the message action in-process.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import List
+
+
+class ExternalRest:
+    """HTTP client External (ExternalRest.java:24-59)."""
+
+    def __init__(self, http_full_address: str):
+        if not http_full_address.startswith("http"):
+            http_full_address = "http://" + http_full_address
+        self.address = http_full_address
+
+    def __str__(self) -> str:
+        return f"ExternalRest({self.address})"
+
+    def receive(self, ei) -> List:
+        from .server import message_from_dict
+        from ..oracle.messages import SendMessage
+
+        body = json.dumps(ei.to_dict()).encode()
+        req = urllib.request.Request(
+            self.address, data=body, method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read().decode() or "[]")
+        res = []
+        for d in out:
+            inner = d.get("message")
+            if isinstance(inner, dict):
+                inner = message_from_dict(inner)
+            # clamp like Server.send_message: a remote naturally answers
+            # with sendTime == now, which the engine rejects mid-run
+            send_time = max(int(d["sendTime"]), ei.arriving_at + 1)
+            res.append(
+                SendMessage(
+                    d["from"], list(d["to"]), send_time,
+                    d.get("delayBetweenSend", 0), inner,
+                )
+            )
+        return res
+
+
+class ExternalMockImplementation:
+    """Logs then executes the action in-process
+    (ExternalMockImplementation.java:27-40)."""
+
+    def __init__(self, network):
+        self.network = network
+
+    def __str__(self) -> str:
+        return type(self).__name__
+
+    def receive(self, ei) -> List:
+        print(f"received:{ei.to_dict()}")
+        if self.network.time != ei.arriving_at:
+            raise ValueError(f"{self.network.time} env:{ei.to_dict()}")
+        f = self.network.get_node_by_id(ei.from_id)
+        t = self.network.get_node_by_id(ei.to)
+        ei.msg.action(self.network, f, t)
+        return []

@@ -1,0 +1,159 @@
+"""IServer implementation (reference: wserver Server.java:20-173).
+
+The reference scans the classpath for Protocol subclasses and Message
+subtypes with Spring and instantiates them reflectively from WParameters
+(Server.java:37-103, :115-126).  Here the protocol registry is explicit
+(core.params.protocol_registry — populated by importing
+wittgenstein_tpu.protocols) and the message-subtype scan walks the
+oracle Message class hierarchy; injection rebuilds messages field-wise,
+mirroring Jackson's field-visibility-ANY mapping (ObjectMapperFactory)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Type
+
+from ..core.params import WParameters, protocol_registry
+from ..oracle.messages import Message, SendMessage
+
+
+@functools.lru_cache(maxsize=1)
+def _message_types() -> Dict[str, Type[Message]]:
+    """All concrete Message subtypes by simple name (Server.java:115-126's
+    classpath scan, done on the live class hierarchy).  Cached: the
+    hierarchy is fixed once wittgenstein_tpu.protocols is imported."""
+    import wittgenstein_tpu.protocols  # noqa: F401  (registers everything)
+
+    out: Dict[str, Type[Message]] = {}
+    stack = list(Message.__subclasses__())
+    while stack:
+        c = stack.pop()
+        stack.extend(c.__subclasses__())
+        out[c.__name__] = c
+    return out
+
+
+def node_to_dict(n) -> dict:
+    """JSON view of a node: the reference serializes all public Node fields
+    (Node.java:22-88) plus protocol counters via Jackson."""
+    d = {
+        "nodeId": n.node_id,
+        "x": n.x,
+        "y": n.y,
+        "cityName": n.city_name,
+        "byzantine": n.byzantine,
+        "down": n.is_down(),
+        "doneAt": n.done_at,
+        "msgReceived": n.msg_received,
+        "msgSent": n.msg_sent,
+        "bytesReceived": n.bytes_received,
+        "bytesSent": n.bytes_sent,
+        "speedRatio": n.speed_ratio,
+        "extraLatency": n.extra_latency,
+        "external": str(n.external) if n.external is not None else None,
+    }
+    return d
+
+
+def message_from_dict(d: dict) -> Message:
+    """Rebuild a message field-wise without calling its constructor —
+    the analog of Jackson's field mapping (WServer.java:99-110)."""
+    d = dict(d)
+    typ = d.pop("type")
+    cls = _message_types().get(typ)
+    if cls is None:
+        raise KeyError(f"unknown message type {typ!r}")
+    m = cls.__new__(cls)
+    for k, v in d.items():
+        setattr(m, k, v)
+    return m
+
+
+class Server:
+    """The in-process server core: one live protocol at a time."""
+
+    def __init__(self):
+        self._protocol = None
+
+    # -- discovery (Server.java:73-113) --------------------------------------
+    def get_protocols(self) -> List[str]:
+        import wittgenstein_tpu.protocols  # noqa: F401
+
+        return sorted(protocol_registry.keys())
+
+    def get_protocol_parameters(self, name: str) -> WParameters:
+        import wittgenstein_tpu.protocols  # noqa: F401
+
+        return protocol_registry[name].default_params()
+
+    def get_parameters_name(self) -> List[str]:
+        import wittgenstein_tpu.protocols  # noqa: F401
+
+        return [r.params_cls.__name__ for r in protocol_registry.values()]
+
+    # -- lifecycle (Server.java:32-70) ---------------------------------------
+    def init(self, name: str, parameters: Optional[WParameters] = None) -> None:
+        import wittgenstein_tpu.protocols  # noqa: F401
+
+        reg = protocol_registry[name]
+        if parameters is None:
+            parameters = reg.default_params()
+        if isinstance(parameters, dict):
+            parameters = reg.params_cls.from_dict(parameters)
+        self._protocol = reg.factory(parameters)
+        self._protocol.init()
+
+    @property
+    def protocol(self):
+        if self._protocol is None:
+            raise RuntimeError("no protocol initialized — POST /w/network/init first")
+        return self._protocol
+
+    def run_ms(self, ms: int) -> None:
+        self.protocol.network().run_ms(ms)
+
+    def get_time(self) -> int:
+        return self.protocol.network().time
+
+    # -- inspection ----------------------------------------------------------
+    def get_node_info(self, node_id: Optional[int] = None):
+        net = self.protocol.network()
+        if node_id is None:
+            return [node_to_dict(n) for n in net.all_nodes]
+        return node_to_dict(net.get_node_by_id(node_id))
+
+    def get_messages(self) -> List[dict]:
+        # msgs.peekMessages (Network.java:279-287 via WServer.java:67-70)
+        return [ei.to_dict() for ei in self.protocol.network().msgs.peek_messages()]
+
+    # -- control -------------------------------------------------------------
+    def start_node(self, node_id: int) -> None:
+        self.protocol.network().get_node_by_id(node_id).start()
+
+    def stop_node(self, node_id: int) -> None:
+        self.protocol.network().get_node_by_id(node_id).stop()
+
+    def set_external(self, node_id: int, address: str) -> None:
+        from .external import ExternalMockImplementation, ExternalRest
+
+        node = self.protocol.network().get_node_by_id(node_id)
+        if address == "mock" or address.startswith("mock:"):
+            node.external = ExternalMockImplementation(self.protocol.network())
+        else:
+            node.external = ExternalRest(address)
+
+    def send_message(self, msg) -> None:
+        """Inject a SendMessage (Server.java:152-161)."""
+        if isinstance(msg, dict):
+            inner = msg.get("message")
+            if isinstance(inner, dict):
+                inner = message_from_dict(inner)
+            msg = SendMessage(
+                msg["from"], list(msg["to"]), msg["sendTime"],
+                msg.get("delayBetweenSend", 0), inner,
+            )
+        net = self.protocol.network()
+        frm = net.get_node_by_id(msg.from_id)
+        dests = [net.get_node_by_id(i) for i in msg.to]
+        send_time = max(msg.send_time, net.time + 1)
+        net.send(msg.message, send_time, frm, dests, msg.delay_between_send)

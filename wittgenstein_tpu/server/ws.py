@@ -1,0 +1,231 @@
+"""HTTP mapping of the IServer contract (reference ws/WServer.java:22-114)
+on the standard library's http.server, plus the batch-sweep job endpoint.
+
+Endpoints (paths kept byte-identical to the reference's @RequestMapping,
+including its start/stop asymmetry — /w/nodes/{id}/start vs
+/w/network/nodes/{id}/stop):
+
+  GET  /w/protocols                      list registered protocol names
+  GET  /w/protocols/{name}               default parameters JSON
+  POST /w/network/init/{name}            init from parameters JSON body
+  POST /w/network/runMs/{ms}             advance the simulation
+  GET  /w/network/time                   current sim time (ms)
+  GET  /w/network/nodes                  all node views
+  GET  /w/network/nodes/{id}             one node view
+  GET  /w/network/messages               in-flight message views
+  POST /w/nodes/{id}/start               restart a node
+  POST /w/network/nodes/{id}/stop        stop a node
+  POST /w/network/nodes/{id}/external    attach an External (body = address)
+  POST /w/network/send                   inject a SendMessage JSON
+  PUT  /w/external_sink                  demo external endpoint (ExternalWS)
+  POST /w/sweep                          batch sweep: {"protocol", "params",
+                                         "runs", "maxTime", "stats"} ->
+                                         RunMultipleTimes aggregates
+
+The simulation core is single-threaded by design (Network.java:10), so all
+handlers serialize on one lock.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from .server import Server
+
+_ROUTES = []
+
+
+def route(method: str, pattern: str, locked: bool = True):
+    """`locked=False` routes run outside the shared simulation lock (for
+    handlers that build their own protocol instances, e.g. /w/sweep)."""
+    rx = re.compile("^" + pattern + "$")
+
+    def deco(fn):
+        _ROUTES.append((method, rx, fn, locked))
+        return fn
+
+    return deco
+
+
+class WServer:
+    """Routing + handler logic; one live Server per instance."""
+
+    def __init__(self):
+        self.server = Server()
+        self.lock = threading.Lock()
+
+    # -- handlers ------------------------------------------------------------
+    @route("GET", r"/w/protocols")
+    def protocols(self, body):
+        return self.server.get_protocols()
+
+    @route("GET", r"/w/protocols/(?P<name>[^/]+)")
+    def protocol_params(self, body, name):
+        p = self.server.get_protocol_parameters(name)
+        return json.loads(p.to_json())
+
+    @route("POST", r"/w/network/init/(?P<name>[^/]+)")
+    def init(self, body, name):
+        params = json.loads(body) if body else None
+        self.server.init(name, params)
+        return {"ok": True}
+
+    @route("POST", r"/w/network/runMs/(?P<ms>\d+)")
+    def run_ms(self, body, ms):
+        self.server.run_ms(int(ms))
+        return {"ok": True, "time": self.server.get_time()}
+
+    @route("GET", r"/w/network/time")
+    def get_time(self, body):
+        return self.server.get_time()
+
+    @route("GET", r"/w/network/nodes")
+    def nodes(self, body):
+        return self.server.get_node_info()
+
+    @route("GET", r"/w/network/nodes/(?P<nid>\d+)")
+    def node(self, body, nid):
+        return self.server.get_node_info(int(nid))
+
+    @route("GET", r"/w/network/messages")
+    def messages(self, body):
+        return self.server.get_messages()
+
+    @route("POST", r"/w/nodes/(?P<nid>\d+)/start")
+    def start_node(self, body, nid):
+        self.server.start_node(int(nid))
+        return {"ok": True}
+
+    @route("POST", r"/w/network/nodes/(?P<nid>\d+)/stop")
+    def stop_node(self, body, nid):
+        self.server.stop_node(int(nid))
+        return {"ok": True}
+
+    @route("POST", r"/w/network/nodes/(?P<nid>\d+)/external")
+    def set_external(self, body, nid):
+        address = body.strip().strip('"')
+        self.server.set_external(int(nid), address)
+        return {"ok": True}
+
+    @route("POST", r"/w/network/send")
+    def send(self, body):
+        self.server.send_message(json.loads(body))
+        return {"ok": True}
+
+    @route("PUT", r"/w/external_sink")
+    def external_sink(self, body):
+        # demo endpoint (ws/ExternalWS.java:22-40): log and return no sends
+        print(f"external_sink received: {body[:200]}")
+        return []
+
+    @route("POST", r"/w/sweep", locked=False)
+    def sweep(self, body):
+        """Batch-sweep job: run a protocol `runs` times (seed = run index,
+        RunMultipleTimes.java:48-63) and return the aggregated stats."""
+        from ..core import stats as SH
+        from ..core.params import protocol_registry
+        from ..core.runners import RunMultipleTimes
+
+        spec = json.loads(body)
+        reg = protocol_registry[spec["protocol"]]
+        params = reg.params_cls.from_dict(spec.get("params", {}))
+        p = reg.factory(params)
+
+        getters = []
+        for s in spec.get("stats", ["doneAt"]):
+            if s == "doneAt":
+                getters.append(SH.DoneAtStatGetter())
+            elif s == "msgReceived":
+                getters.append(SH.MsgReceivedStatGetter())
+            else:
+                raise KeyError(f"unknown stat {s!r}")
+        runner = RunMultipleTimes(
+            p, spec.get("runs", 1), spec.get("maxTime", 10_000), getters
+        )
+        cont = RunMultipleTimes.cont_until_done() if spec.get("untilDone", True) else None
+        stats = runner.run(cont)
+        out = []
+        for g, st in zip(getters, stats):
+            out.append({f: getattr(st, _snake(f)) for f in g.fields()})
+        return {"protocol": spec["protocol"], "runs": spec.get("runs", 1), "stats": out}
+
+    # -- dispatch ------------------------------------------------------------
+    def dispatch(self, method: str, path: str, body: str) -> Tuple[int, object]:
+        for m, rx, fn, locked in _ROUTES:
+            if m != method:
+                continue
+            mt = rx.match(path)
+            if mt:
+                if locked:
+                    with self.lock:
+                        return self._invoke(fn, body, mt.groupdict())
+                return self._invoke(fn, body, mt.groupdict())
+        return 404, {"error": f"no route {method} {path}"}
+
+    def _invoke(self, fn, body, kwargs) -> Tuple[int, object]:
+        try:
+            return 200, fn(self, body, **kwargs)
+        except (KeyError, ValueError, TypeError, AttributeError) as e:
+            return 400, {"error": f"{type(e).__name__}: {e}"}
+        except RuntimeError as e:
+            return 409, {"error": str(e)}
+        except Exception as e:  # never drop the socket without a response
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+
+
+def _snake(name: str) -> str:
+    return re.sub(r"(?<=[a-z])([A-Z])", r"_\1", name).lower()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    ws: WServer  # set by serve()
+
+    def _do(self, method: str):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length).decode() if length else ""
+        status, payload = self.ws.dispatch(method, self.path, body)
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        self._do("GET")
+
+    def do_POST(self):
+        self._do("POST")
+
+    def do_PUT(self):
+        self._do("PUT")
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+
+def serve(port: int = 0, ws: Optional[WServer] = None) -> ThreadingHTTPServer:
+    """Start the HTTP server on `port` (0 = ephemeral); returns the server
+    (serve_forever runs on a daemon thread; .shutdown() to stop)."""
+    ws = ws or WServer()
+    handler = type("BoundHandler", (_Handler,), {"ws": ws})
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd
+
+
+if __name__ == "__main__":
+    import sys
+
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 8080
+    httpd = serve(port)
+    print(f"wittgenstein-tpu server on http://127.0.0.1:{httpd.server_address[1]}/w/protocols")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        httpd.shutdown()
