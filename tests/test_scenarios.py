@@ -1,0 +1,51 @@
+"""Batched sweep runner + HandelScenarios battery (HandelScenarios.java:22
+rebuilt as stacked vmap sweeps with CSV output)."""
+
+import numpy as np
+
+from wittgenstein_tpu.scenarios.handel_scenarios import (
+    CSV_FIELDS,
+    run_scenario,
+)
+from wittgenstein_tpu.scenarios.sweep import (
+    SweepConfig,
+    default_params,
+    run_sweep,
+)
+
+
+class TestSweepRunner:
+    def test_mixed_static_params_not_merged(self):
+        """Configs with different traced-static parameters (threshold!)
+        must not share a compiled program — the sweep that found this bug:
+        different dead ratios imply different thresholds."""
+        configs = [
+            SweepConfig("byz", dr, default_params(64, dead_ratio=dr, byzantine_suicide=dr > 0))
+            for dr in (0.1, 0.3)
+        ]
+        stats = run_sweep(configs, replicas=2, sim_ms=4000)
+        for bs in stats:
+            assert bs.done_at_min > 0  # every live node converged
+
+    def test_tor_sweep_single_group(self):
+        """Tor fractions share one program (only node columns differ), and
+        more Tor nodes means slower aggregation."""
+        configs = [
+            SweepConfig("tor", tor, default_params(32, dead_ratio=0.0, tor=tor))
+            for tor in (0.0, 0.5)
+        ]
+        stats = run_sweep(configs, replicas=2, sim_ms=6000)
+        assert all(bs.done_at_min > 0 for bs in stats)
+        assert stats[1].done_at_avg > stats[0].done_at_avg
+
+    def test_scenario_csv(self, tmp_path):
+        out = tmp_path / "byz.csv"
+        stats = run_scenario(
+            "byzantine", nodes=32, replicas=2, sim_ms=5000, out=str(out)
+        )
+        assert len(stats) == 6
+        lines = out.read_text().strip().splitlines()
+        assert lines[1] == ",".join(CSV_FIELDS)
+        assert len(lines) == 2 + 6
+        # attack slows aggregation vs the clean config
+        assert stats[-1].done_at_avg > stats[0].done_at_avg

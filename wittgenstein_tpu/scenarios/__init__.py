@@ -1,0 +1,14 @@
+"""Scenario drivers (L4) on the batched engine.
+
+The reference's research drivers (HandelScenarios.java:22,
+P2PHandelScenarios.java, OptimisticP2PSignatureScenarios.java) run one
+configuration at a time through RunMultipleTimes' sequential reseeded
+loop.  Here a whole sweep — (configuration x replica) — is ONE stacked
+batched computation (`jax.vmap` over the leading axis), reduced to
+BasicStats rows on the device and emitted as the same CSV shape the
+reference prints.
+"""
+
+from .sweep import BasicStats, SweepConfig, run_sweep
+
+__all__ = ["BasicStats", "SweepConfig", "run_sweep"]

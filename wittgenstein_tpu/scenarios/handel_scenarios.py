@@ -1,0 +1,116 @@
+"""HandelScenarios on the batched engine (HandelScenarios.java:22).
+
+One command reproduces a scenario battery as CSV + stdout lines in the
+reference's `id, nodes, value, BasicStats` shape — but each battery is a
+single stacked batched computation instead of sequential reseeded runs:
+
+    python -m wittgenstein_tpu.scenarios.handel_scenarios tor \
+        --nodes 128 --replicas 4 --out tor.csv
+
+Scenarios (HandelScenarios.java refs):
+  tor        impact of the ratio of nodes behind Tor (:177-190)
+  byzantine  byzantineSuicide dead-ratio sweep 0-50% (:204-236)
+  hidden     hiddenByzantine dead-ratio sweep (:259-287)
+  desync     desynchronized start impact (:192-202 noSyncStart)
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional
+
+from ..tools.csv_formatter import CSVFormatter
+from .sweep import BasicStats, SweepConfig, default_params, run_sweep
+
+CSV_FIELDS = [
+    "id",
+    "nodes",
+    "value",
+    "done_at_min",
+    "done_at_avg",
+    "done_at_max",
+    "msg_rcv_min",
+    "msg_rcv_avg",
+    "msg_rcv_max",
+    "msg_filtered_avg",
+    "sigs_checked_avg",
+]
+
+
+def tor_configs(nodes: int) -> List[SweepConfig]:
+    from ..core.registries import TOR_RATIOS
+
+    return [
+        SweepConfig("tor", tor, default_params(nodes, dead_ratio=0.0, tor=tor))
+        for tor in TOR_RATIOS
+    ]
+
+
+def byzantine_configs(nodes: int, hidden: bool = False) -> List[SweepConfig]:
+    sid = "byzHidden" if hidden else "byzSuicide"
+    out = []
+    for dr in (0.0, 0.10, 0.20, 0.30, 0.40, 0.50):
+        out.append(
+            SweepConfig(
+                sid,
+                dr,
+                default_params(
+                    nodes,
+                    dead_ratio=dr,
+                    byzantine_suicide=not hidden and dr > 0,
+                    hidden_byzantine=hidden and dr > 0,
+                ),
+            )
+        )
+    return out
+
+
+def desync_configs(nodes: int) -> List[SweepConfig]:
+    return [
+        SweepConfig(
+            "noSyncStart", s, default_params(nodes, dead_ratio=0.0, desynchronized_start=s)
+        )
+        for s in (0, 50, 100, 200, 400, 800)
+    ]
+
+
+SCENARIOS = {
+    "tor": tor_configs,
+    "byzantine": byzantine_configs,
+    "hidden": lambda n: byzantine_configs(n, hidden=True),
+    "desync": desync_configs,
+}
+
+
+def run_scenario(
+    name: str,
+    nodes: int = 128,
+    replicas: int = 4,
+    sim_ms: int = 4000,
+    out: Optional[str] = None,
+) -> List[BasicStats]:
+    configs = SCENARIOS[name](nodes)
+    stats = run_sweep(configs, replicas=replicas, sim_ms=sim_ms)
+    csv = CSVFormatter(name, CSV_FIELDS)
+    for c, bs in zip(configs, stats):
+        print(f"{c.label}, {nodes}, {c.value}, {bs}")
+        csv.add({"id": c.label, "nodes": nodes, "value": c.value, **bs.row()})
+    if out:
+        csv.save(out)
+        print(f"wrote {out}")
+    return stats
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("scenario", choices=sorted(SCENARIOS))
+    ap.add_argument("--nodes", type=int, default=128)
+    ap.add_argument("--replicas", type=int, default=4)
+    ap.add_argument("--sim-ms", type=int, default=4000)
+    ap.add_argument("--out", default=None)
+    a = ap.parse_args(argv)
+    run_scenario(a.scenario, a.nodes, a.replicas, a.sim_ms, a.out)
+
+
+if __name__ == "__main__":
+    main()

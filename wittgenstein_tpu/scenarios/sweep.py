@@ -1,0 +1,169 @@
+"""The batched sweep runner: stacked configurations x replicas in one
+`run_ms_batched` call.
+
+This is the TPU replacement for HandelScenarios.run
+(HandelScenarios.java:140-160): where the reference runs `rounds`
+sequential reseeded simulations per configuration and averages
+StatsHelper outputs, here every (config, replica) pair is one row of a
+stacked state pytree and the whole sweep executes in lockstep.  Configs
+sharing one traced program (same node count and attack-mode flags — the
+static branches of the batched protocol) are grouped into one jit;
+statistics reduce on-device over the (replica, node) axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..engine import stack_states
+from ..protocols.handel import HandelParameters
+from ..protocols.handel_batched import make_handel
+
+
+@dataclasses.dataclass
+class BasicStats:
+    """The reference's per-configuration summary (HandelScenarios.java:60-90):
+    doneAt and msgReceived min/avg/max over live nodes, plus the
+    msgFiltered and sigsChecked averages."""
+
+    done_at_min: int
+    done_at_avg: int
+    done_at_max: int
+    msg_rcv_min: int
+    msg_rcv_avg: int
+    msg_rcv_max: int
+    msg_filtered_avg: int
+    sigs_checked_avg: int
+
+    def __str__(self) -> str:
+        return (
+            f"doneAtAvg={self.done_at_avg}, doneAtMin={self.done_at_min}"
+            f", doneAtMax={self.done_at_max}, msgRcvAvg={self.msg_rcv_avg}"
+            f", msgFilteredAvg={self.msg_filtered_avg}"
+            f", sigsCheckedAvg={self.sigs_checked_avg}"
+        )
+
+    def row(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class SweepConfig:
+    """One sweep point: a Handel configuration plus its sweep label."""
+
+    label: str
+    value: object  # the swept variable's value (tor %, byz fraction, ...)
+    params: HandelParameters
+
+
+def _group_key(p: HandelParameters):
+    """Configs share one traced program iff every parameter the protocol
+    bakes into the computation graph matches.  Only the fields that live
+    in the STATE (down set, start times, node positions/speeds) may
+    differ inside a group: nodes_down / bad_nodes / desynchronized_start /
+    node_builder_name."""
+    return (
+        p.node_count,
+        p.threshold,
+        p.pairing_time,
+        p.level_wait_time,
+        p.extra_cycle,
+        p.dissemination_period_ms,
+        p.fast_path,
+        p.byzantine_suicide,
+        p.hidden_byzantine,
+        p.network_latency_name,
+        p.window_initial,
+        p.window_minimum,
+        p.window_maximum,
+        p.window_increase_factor,
+        p.window_decrease_factor,
+    )
+
+
+def run_sweep(
+    configs: List[SweepConfig],
+    replicas: int = 4,
+    sim_ms: int = 3000,
+    seed0: int = 0,
+) -> List[BasicStats]:
+    """Run every (config x replica) in stacked batches; one BasicStats per
+    config, reduced over live nodes of all its replicas."""
+    results: Dict[int, BasicStats] = {}
+
+    # group by traced-program shape so each group is ONE compiled sweep
+    groups: Dict[tuple, List[int]] = {}
+    for i, c in enumerate(configs):
+        groups.setdefault(_group_key(c.params), []).append(i)
+
+    for idxs in groups.values():
+        states, nets = [], []
+        for i in idxs:
+            net, st = make_handel(configs[i].params)
+            for r in range(replicas):
+                states.append(
+                    st._replace(seed=st.seed * 0 + (seed0 + 1000 * i + r))
+                )
+            nets.append(net)
+        stacked = stack_states(states)
+        out = nets[0].run_ms_batched(stacked, sim_ms)
+
+        down = np.asarray(out.down)
+        done = np.asarray(out.done_at)
+        rcv = np.asarray(out.msg_received)
+        filt = np.asarray(out.proto["msg_filtered"])
+        checked = np.asarray(out.proto["sigs_checked"])
+        for gpos, i in enumerate(idxs):
+            sl = slice(gpos * replicas, (gpos + 1) * replicas)
+            live = ~down[sl]
+            d = done[sl][live]
+            r = rcv[sl][live]
+            results[i] = BasicStats(
+                int(d.min()),
+                int(d.mean()),
+                int(d.max()),
+                int(r.min()),
+                int(r.mean()),
+                int(r.max()),
+                int(filt[sl][live].mean()),
+                int(checked[sl][live].mean()),
+            )
+
+    return [results[i] for i in range(len(configs))]
+
+
+def default_params(
+    nodes: int,
+    dead_ratio: Optional[float] = None,
+    tor: Optional[float] = None,
+    desynchronized_start: Optional[int] = None,
+    byzantine_suicide: bool = False,
+    hidden_byzantine: bool = False,
+) -> HandelParameters:
+    """HandelScenarios.defaultParams (HandelScenarios.java:92-122): the
+    canonical scenario configuration."""
+    from ..core.registries import RANDOM, builder_name
+
+    dead_ratio = 0.10 if dead_ratio is None else dead_ratio
+    dead = int(nodes * dead_ratio)
+    threshold = int(nodes * (1.0 - dead_ratio) * 0.99)
+    threshold = max(2, min(threshold, nodes - dead))
+    return HandelParameters(
+        node_count=nodes,
+        threshold=threshold,
+        pairing_time=4,
+        level_wait_time=50,
+        extra_cycle=10,
+        dissemination_period_ms=20,
+        fast_path=10,
+        nodes_down=dead,
+        node_builder_name=builder_name(RANDOM, True, tor or 0.0),
+        network_latency_name=None,
+        desynchronized_start=desynchronized_start or 0,
+        byzantine_suicide=byzantine_suicide,
+        hidden_byzantine=hidden_byzantine,
+    )

@@ -19,17 +19,27 @@ from ..oracle.messages import Message, SendMessage
 
 @functools.lru_cache(maxsize=1)
 def _message_types() -> Dict[str, Type[Message]]:
-    """All concrete Message subtypes by simple name (Server.java:115-126's
-    classpath scan, done on the live class hierarchy).  Cached: the
-    hierarchy is fixed once wittgenstein_tpu.protocols is imported."""
+    """All concrete Message subtypes (Server.java:115-126's classpath scan,
+    done on the live class hierarchy).  Keys: the qualified
+    '<module>.<Class>' name always, plus the simple class name when it is
+    unambiguous — several protocols define e.g. their own SendSigs, and a
+    silent simple-name collision would inject the wrong class.  Cached:
+    the hierarchy is fixed once wittgenstein_tpu.protocols is imported."""
     import wittgenstein_tpu.protocols  # noqa: F401  (registers everything)
 
     out: Dict[str, Type[Message]] = {}
+    ambiguous = set()
     stack = list(Message.__subclasses__())
     while stack:
         c = stack.pop()
         stack.extend(c.__subclasses__())
-        out[c.__name__] = c
+        out[f"{c.__module__.rsplit('.', 1)[-1]}.{c.__name__}"] = c
+        if c.__name__ in out:
+            ambiguous.add(c.__name__)
+        else:
+            out[c.__name__] = c
+    for name in ambiguous:
+        out.pop(name, None)
     return out
 
 
@@ -62,7 +72,11 @@ def message_from_dict(d: dict) -> Message:
     typ = d.pop("type")
     cls = _message_types().get(typ)
     if cls is None:
-        raise KeyError(f"unknown message type {typ!r}")
+        hint = [k for k in _message_types() if k.endswith("." + typ)]
+        raise KeyError(
+            f"unknown or ambiguous message type {typ!r}"
+            + (f" — use one of {hint}" if hint else "")
+        )
     m = cls.__new__(cls)
     for k, v in d.items():
         setattr(m, k, v)

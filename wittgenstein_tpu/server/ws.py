@@ -126,6 +126,8 @@ class WServer:
     def sweep(self, body):
         """Batch-sweep job: run a protocol `runs` times (seed = run index,
         RunMultipleTimes.java:48-63) and return the aggregated stats."""
+        import wittgenstein_tpu.protocols  # noqa: F401  (fills the registry)
+
         from ..core import stats as SH
         from ..core.params import protocol_registry
         from ..core.runners import RunMultipleTimes
@@ -150,7 +152,7 @@ class WServer:
         stats = runner.run(cont)
         out = []
         for g, st in zip(getters, stats):
-            out.append({f: getattr(st, _snake(f)) for f in g.fields()})
+            out.append({f: st.get(f) for f in g.fields()})
         return {"protocol": spec["protocol"], "runs": spec.get("runs", 1), "stats": out}
 
     # -- dispatch ------------------------------------------------------------
@@ -175,10 +177,6 @@ class WServer:
             return 409, {"error": str(e)}
         except Exception as e:  # never drop the socket without a response
             return 500, {"error": f"{type(e).__name__}: {e}"}
-
-
-def _snake(name: str) -> str:
-    return re.sub(r"(?<=[a-z])([A-Z])", r"_\1", name).lower()
 
 
 class _Handler(BaseHTTPRequestHandler):
