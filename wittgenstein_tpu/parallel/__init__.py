@@ -1,0 +1,22 @@
+"""Multi-chip parallelism (the SURVEY §5 'distributed communication
+backend' analog).
+
+The reference has no distributed execution at all — its scale story is
+one Java thread plus sequential reseeded runs (RunMultipleTimes.java:
+48-63).  On TPU the same two axes become device axes:
+
+  * replica axis — independent simulations sharded over a
+    `jax.sharding.Mesh` with NamedSharding; XLA inserts the collectives
+    for cross-device statistics (replica_shard).
+  * node axis — the SoA node state of ONE huge simulation sharded with
+    `shard_map`, communicating through explicit collectives (psum /
+    all_gather) over the mesh axis (node_shard: the working spike).
+
+Both run identically on a virtual CPU mesh
+(--xla_force_host_platform_device_count), a TPU pod slice (ICI), or
+multi-host (DCN) — the mesh is the only thing that changes.
+"""
+
+from .replica_shard import shard_replicas, sharded_run_stats
+
+__all__ = ["shard_replicas", "sharded_run_stats"]

@@ -1,0 +1,117 @@
+"""Node-axis sharding spike: one simulation's node state split across
+devices with `shard_map` + explicit collectives.
+
+The replica axis (replica_shard) scales the number of simulations; this
+axis scales ONE simulation past a single device's memory — the analog of
+the sequence/context parallelism axis in ML workloads (SURVEY §5).  The
+spike shards the PingPong broadcast/reply pattern: each device owns a
+block of node columns, computes its block's ping and pong arrival times
+with the real latency models and the engine's counter RNG, and the
+witness's pong progression is a `psum` over the mesh axis.  The sharded
+result is bit-identical to the unsharded computation (the CI test), on a
+virtual CPU mesh or real chips alike.
+
+What this proves for the full engine: static node columns shard cleanly;
+latency kernels are local given the peer row (here the witness row is
+replicated — for general protocols the peer rows travel via
+all_gather/all_to_all, which is the next step flagged in SURVEY §7);
+statistics reduce with one collective.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..core.latency import LatencyStatic, vec_latency
+from ..core.node import Node, build_node_columns
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..engine.rng import hash32, pseudo_delta
+from ..utils.javarand import JavaRandom
+
+
+def _build_population(node_ct: int, node_builder_name, network_latency_name):
+    nb = registry_node_builders.get_by_name(node_builder_name)
+    latency = registry_network_latencies.get_by_name(network_latency_name)
+    rd = JavaRandom(0)
+    nodes = [Node(rd, nb) for _ in range(node_ct)]
+    cols = build_node_columns(nodes, getattr(latency, "city_index", None))
+    return latency, cols
+
+
+def pingpong_progression(
+    node_ct: int,
+    query_times,
+    mesh: Optional[Mesh] = None,
+    axis: str = "nodes",
+    node_builder_name: Optional[str] = None,
+    network_latency_name: Optional[str] = None,
+    seed: int = 0,
+):
+    """Witness pong counts at `query_times`.  With a mesh: node columns are
+    sharded over `axis` via shard_map and the counts are psum-reduced; the
+    result is bit-identical to the unsharded path."""
+    latency, cols = _build_population(node_ct, node_builder_name, network_latency_name)
+    qts = jnp.asarray(query_times, jnp.int32)
+
+    # row 0 of the static table is the witness, replicated to every shard;
+    # rows 1.. are the (shardable) node blocks
+    x = np.asarray(cols["x"])
+    y = np.asarray(cols["y"])
+    el = np.asarray(cols["extra_latency"])
+    ci = np.asarray(cols.get("city_idx", np.full(node_ct, -1)))
+    ids = jnp.arange(node_ct, dtype=jnp.int32)
+
+    def counts(x_b, y_b, el_b, ci_b, ids_b):
+        """Pong-at-witness arrival times for this block, with the engine's
+        send semantics: Ping multicast at t=1 with one shared seed +
+        per-GLOBAL-destination pseudo delta (MultipleDestEnvelope), Pong
+        replies one ms after delivery.  Static row 0 is the witness;
+        gathers use local positions, RNG uses global ids."""
+        static = LatencyStatic(
+            jnp.concatenate([jnp.asarray(x[:1]), x_b]),
+            jnp.concatenate([jnp.asarray(y[:1]), y_b]),
+            jnp.concatenate([jnp.asarray(el[:1]), el_b]),
+            jnp.concatenate([jnp.asarray(ci[:1]), ci_b]),
+        )
+        lpos = jnp.arange(ids_b.shape[0], dtype=jnp.int32) + 1
+        zero = jnp.zeros_like(lpos)
+        ping_seed = hash32(jnp.int32(seed), jnp.int32(1), jnp.int32(0xA0))
+        d1 = pseudo_delta(ids_b, ping_seed)
+        arr1 = 1 + vec_latency(latency, static, zero, lpos, d1)
+        pong_seed = hash32(jnp.int32(seed), arr1 + 1, ids_b, jnp.int32(0xB0))
+        d2 = pseudo_delta(zero, pong_seed)
+        arr = arr1 + 1 + vec_latency(latency, static, lpos, zero, d2)
+        return jnp.sum(
+            (arr[None, :] <= qts[:, None]).astype(jnp.int32), axis=1
+        )
+
+    if mesh is None:
+        return counts(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(el), jnp.asarray(ci), ids
+        )
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(),
+    )
+    def sharded(x_b, y_b, el_b, ci_b, ids_b):
+        local = counts(x_b, y_b, el_b, ci_b, ids_b)
+        return jax.lax.psum(local, axis)
+
+    return sharded(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(el), jnp.asarray(ci), ids
+    )

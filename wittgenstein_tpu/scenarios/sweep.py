@@ -60,28 +60,21 @@ class SweepConfig:
     params: HandelParameters
 
 
+# Parameter fields that live in the per-row STATE (down set, start times,
+# node positions/speeds) rather than the traced program; ONLY these may
+# differ between configs sharing one compiled sweep.  Everything else —
+# including any future field — splits the group by default, so a new
+# traced knob can never silently run under another config's program.
+_STATE_ONLY_FIELDS = frozenset(
+    {"nodes_down", "bad_nodes", "desynchronized_start", "node_builder_name"}
+)
+
+
 def _group_key(p: HandelParameters):
-    """Configs share one traced program iff every parameter the protocol
-    bakes into the computation graph matches.  Only the fields that live
-    in the STATE (down set, start times, node positions/speeds) may
-    differ inside a group: nodes_down / bad_nodes / desynchronized_start /
-    node_builder_name."""
-    return (
-        p.node_count,
-        p.threshold,
-        p.pairing_time,
-        p.level_wait_time,
-        p.extra_cycle,
-        p.dissemination_period_ms,
-        p.fast_path,
-        p.byzantine_suicide,
-        p.hidden_byzantine,
-        p.network_latency_name,
-        p.window_initial,
-        p.window_minimum,
-        p.window_maximum,
-        p.window_increase_factor,
-        p.window_decrease_factor,
+    return tuple(
+        (f.name, getattr(p, f.name))
+        for f in dataclasses.fields(p)
+        if f.name not in _STATE_ONLY_FIELDS
     )
 
 
@@ -101,16 +94,17 @@ def run_sweep(
         groups.setdefault(_group_key(c.params), []).append(i)
 
     for idxs in groups.values():
-        states, nets = [], []
+        states, net = [], None
         for i in idxs:
-            net, st = make_handel(configs[i].params)
+            # one net serves the whole group (identical traced programs)
+            group_net, st = make_handel(configs[i].params)
+            net = net or group_net
             for r in range(replicas):
                 states.append(
                     st._replace(seed=st.seed * 0 + (seed0 + 1000 * i + r))
                 )
-            nets.append(net)
         stacked = stack_states(states)
-        out = nets[0].run_ms_batched(stacked, sim_ms)
+        out = net.run_ms_batched(stacked, sim_ms)
 
         down = np.asarray(out.down)
         done = np.asarray(out.done_at)
