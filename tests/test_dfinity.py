@@ -55,3 +55,54 @@ class TestDfinity:
         d.network().run(20)
         h_after = d.network().observer.head.height
         assert h_after > h_before
+
+
+class TestDocumentedRuns:
+    """The runs documented in Dfinity.java:452-480.
+
+    The trailing comments publish block counts for '~20K seconds' runs
+    (5685 bad network / 4665 with a 20% partition / 6733 perfect
+    network), but the shipped main() only simulates 2100 s — the
+    published numbers are not reproducible from the shipped code even in
+    Java, and block counts drift with any RNG-stream difference over 20M
+    simulated ms.  What IS checkable: this port's runs are deterministic
+    (pinned below), the transaction counter tracks simulated time like
+    the reference's (20.1M tx over the 20k-s shape vs the published
+    20.2M, within 0.6%), and the partition lowers the block count, the
+    published direction."""
+
+    def _block_count(self, bc):
+        cur = bc.network().observer.head
+        n = 0
+        while cur is not bc.network().observer.genesis:
+            n += 1
+            cur = cur.parent
+        return n, bc.network().observer.head.last_tx_id
+
+    def _fresh(self):
+        from wittgenstein_tpu.oracle.blockchain import Block
+        from wittgenstein_tpu.protocols.dfinity import Dfinity, DfinityParameters
+
+        Block.reset_block_ids()
+        bc = Dfinity(DfinityParameters())
+        bc.init()
+        return bc
+
+    def test_shipped_main_no_partition(self):
+        bc = self._fresh()
+        bc.network().run(50)
+        bc.network().run(2000)
+        bc.network().run(50)
+        blocks, tx = self._block_count(bc)
+        assert (blocks, tx) == (685, 2095063)
+
+    def test_shipped_main_with_partition(self):
+        bc = self._fresh()
+        bc.network().run(50)
+        bc.network().partition(0.20)
+        bc.network().run(2000)
+        bc.network().end_partition()
+        bc.network().run(50)
+        blocks, tx = self._block_count(bc)
+        assert (blocks, tx) == (675, 2095771)
+        assert blocks < 685  # the published direction (4665 < 5685)

@@ -1,0 +1,97 @@
+"""NodeDrawer/GIF (tools/NodeDrawer.java + GifSequenceWriter.java) and the
+Kademlia XOR util (utils/Kademlia.java:5-29)."""
+
+import pytest
+
+from wittgenstein_tpu.tools.node_drawer import NodeDrawer, NodeStatus, _make_color
+from wittgenstein_tpu.utils.kademlia import distance
+
+
+class DoneStatus(NodeStatus):
+    def get_val(self, n):
+        return 1 if n.done_at > 0 else 0
+
+    def is_special(self, n):
+        return n.node_id == 0
+
+    def get_max(self):
+        return 1
+
+    def get_min(self):
+        return 0
+
+
+class TestNodeDrawer:
+    def test_animated_gif_and_png(self, tmp_path):
+        from wittgenstein_tpu.protocols.pingpong import PingPong, PingPongParameters
+
+        p = PingPong(PingPongParameters(node_ct=64))
+        p.init()
+
+        class GotPing(NodeStatus):
+            """Green once the broadcast reached the node — spreads over
+            several hundred ms, so frames genuinely differ."""
+
+            def get_val(self, n):
+                return 1 if n.msg_received > 0 else 0
+
+            def is_special(self, n):
+                return n.node_id == 0
+
+            def get_max(self):
+                return 1
+
+            def get_min(self):
+                return 0
+
+        gif = tmp_path / "anim.gif"
+        png = tmp_path / "last.png"
+        with NodeDrawer(GotPing(), str(gif), 10) as nd:
+            for _ in range(4):
+                p.network().run_ms(100)
+                nd.draw_new_state(p.network().time, p.network().live_nodes())
+            nd.write_last_to_png(str(png))
+        assert gif.stat().st_size > 1000
+        assert png.stat().st_size > 1000
+        # GIF really is animated (several frames)
+        from PIL import Image
+
+        with Image.open(str(gif)) as im:
+            assert getattr(im, "n_frames", 1) == 4
+
+    def test_positions_stable_and_disjoint(self):
+        from wittgenstein_tpu.protocols.pingpong import PingPong, PingPongParameters
+
+        p = PingPong(PingPongParameters(node_ct=128))
+        p.init()
+        nd = NodeDrawer(DoneStatus(), None, 10)
+        nodes = p.network().live_nodes()
+        pos1 = [nd._find_pos(n) for n in nodes]
+        pos2 = [nd._find_pos(n) for n in nodes]
+        assert pos1 == pos2  # stable across frames
+        assert len(set(pos1)) == len(pos1)  # non-overlapping allocations
+
+    def test_color_ramp(self):
+        assert _make_color(0) == (255, 0, 0)  # red at min
+        assert _make_color(510) == (0, 255, 0)  # green at max
+        r, g, b = _make_color(255)
+        assert r == 255 and g > 200  # yellow-ish middle
+
+    def test_bad_minmax_rejected(self):
+        class Bad(DoneStatus):
+            def get_max(self):
+                return -1
+
+        with pytest.raises(ValueError):
+            NodeDrawer(Bad(), None, 10)
+
+
+class TestKademlia:
+    def test_distance_goldens(self):
+        assert distance(b"\x00\x00", b"\x00\x00") == 0
+        assert distance(b"\x80\x00", b"\x00\x00") == 16  # top bit differs
+        assert distance(b"\x00\x01", b"\x00\x00") == 1  # bottom bit
+        assert distance(b"\x00\xf0", b"\x00\x00") == 8
+        assert distance(b"\x01\x00", b"\x00\x00") == 9
+        # symmetry
+        assert distance(b"\x12\x34", b"\x43\x21") == distance(b"\x43\x21", b"\x12\x34")

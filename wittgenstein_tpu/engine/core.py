@@ -113,12 +113,14 @@ class BatchedNetwork:
         n_nodes: int,
         capacity: int = 1 << 14,
         msg_discard_time: int = int(INT_MAX),
+        throughput=None,  # optional core.throughput.MathisNetworkThroughput
     ):
         self.protocol = protocol
         self.latency = latency
         self.n_nodes = n_nodes
         self.capacity = capacity
         self.msg_discard_time = msg_discard_time
+        self.throughput = throughput
         self.payload_width = protocol.PAYLOAD_WIDTH
         sizes = [protocol.msg_size(t) for t in range(protocol.n_msg_types())]
         self._msg_sizes = np.asarray(sizes, dtype=np.int32)
@@ -204,7 +206,14 @@ class BatchedNetwork:
         )
         delta = pseudo_delta(to_idx, seed)
         static = LatencyStatic(state.x, state.y, state.extra_latency, state.city_idx)
-        lat = vec_latency(self.latency, static, from_idx, to_idx, delta)
+        if self.throughput is not None:
+            # size-dependent Mathis delay (vectorized twin of the oracle's
+            # transit_ms throughput path), priced off THIS network's latency
+            lat = self.throughput.vec_delay(
+                static, from_idx, to_idx, delta, size, nl=self.latency
+            )
+        else:
+            lat = vec_latency(self.latency, static, from_idx, to_idx, delta)
         arrival = jnp.asarray(send_time, jnp.int32) + lat
         pid_f = self.partition_id(state, state.x[from_idx])
         pid_t = self.partition_id(state, state.x[to_idx])

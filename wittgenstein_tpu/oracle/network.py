@@ -159,7 +159,7 @@ class MultipleDestEnvelope(_Envelope):
         delta = get_pseudo_random(dest_id, self.random_seed)
         f = network.get_node_by_id(self._from_id)
         t = network.get_node_by_id(dest_id)
-        return self.send_time + network.network_latency.get_latency(f, t, delta)
+        return self.send_time + network.transit_ms(self.message, f, t, delta)
 
     def get_message(self):
         return self.message
@@ -311,6 +311,7 @@ class Network(Generic[TN]):
         self.partitions_in_x: List[int] = []
         self.msg_discard_time = 2**31 - 1
         self.network_latency: NetworkLatency = IC3NetworkLatency()
+        self.network_throughput = None  # optional Mathis model (opt-in)
         self.time = 0
 
     # -- helpers -----------------------------------------------------------
@@ -446,8 +447,8 @@ class Network(Generic[TN]):
             and not from_node.is_down()
             and not to_node.is_down()
         ):
-            nt = self.network_latency.get_latency(
-                from_node, to_node, get_pseudo_random(to_node.node_id, random_seed)
+            nt = self.transit_ms(
+                m, from_node, to_node, get_pseudo_random(to_node.node_id, random_seed)
             )
             if nt < self.msg_discard_time:
                 return (to_node, send_time + nt)
@@ -586,6 +587,27 @@ class Network(Generic[TN]):
             nl = MeasuredNetworkLatency(nl[0], nl[1])
         self.network_latency = nl
         return self
+
+    def set_network_throughput(self, tp) -> "Network[TN]":
+        """Enable TCP-throughput-aware delays (MathisNetworkThroughput):
+        message transit becomes size-dependent.  The reference defines the
+        model (NetworkThroughput.java:17-57) but never wires it into its
+        Network; making it enableable is this rebuild's upgrade."""
+        if self.msgs.size() != 0:
+            raise RuntimeError(
+                "You can't change the throughput while the system as on going messages"
+            )
+        self.network_throughput = tp
+        return self
+
+    def transit_ms(self, m, from_node, to_node, delta: int) -> int:
+        """One-way transit time: latency, or the Mathis size-dependent
+        delay when a throughput model is set."""
+        if self.network_throughput is not None:
+            return self.network_throughput.delay(
+                from_node, to_node, delta, m.size(), nl=self.network_latency
+            )
+        return self.network_latency.get_latency(from_node, to_node, delta)
 
 
 class Protocol:

@@ -1,0 +1,24 @@
+"""Kademlia XOR distance (utils/Kademlia.java:5-29 — the only implemented
+part of the reference file; the rest is commented-out design notes)."""
+
+from __future__ import annotations
+
+
+def distance(v1: bytes, v2: bytes) -> int:
+    """Bit-length-style XOR distance between two equal-length byte strings:
+    the index (from the top) of the highest differing bit, 0 if equal."""
+    assert len(v1) == len(v2)
+    if v1 == v2:
+        return 0
+    dist = len(v1) * 8
+    for a, b in zip(v1, v2):
+        xor = (a ^ b) & 0xFF
+        if xor == 0:
+            dist -= 8
+        else:
+            p = 7
+            while ((xor >> p) & 0x01) == 0:
+                p -= 1
+                dist -= 1
+            break
+    return dist
