@@ -49,3 +49,35 @@ class TestSweepRunner:
         assert len(lines) == 2 + 6
         # attack slows aggregation vs the clean config
         assert stats[-1].done_at_avg > stats[0].done_at_avg
+
+
+class TestOracleScenarioSuites:
+    """P2PHandelScenarios + OptimisticP2PSignatureScenarios ports
+    (P2PHandelScenarios.java:17-283, OptimisticP2PSignatureScenarios.java)."""
+
+    def test_p2phandel_scaling(self):
+        from wittgenstein_tpu.scenarios.oracle_scenarios import p2phandel_scaling
+
+        stats = p2phandel_scaling(rounds=2, max_nodes=64)
+        assert len(stats) == 2  # 32, 64
+        assert all(bs.done_at_min > 0 for bs in stats)
+        # more nodes -> more messages received on average
+        assert stats[1].msg_rcv_avg > stats[0].msg_rcv_avg
+
+    def test_optimistic_scaling(self):
+        from wittgenstein_tpu.scenarios.oracle_scenarios import optimistic_scaling
+
+        stats = optimistic_scaling(rounds=2, max_nodes=128)
+        assert len(stats) == 2
+        assert all(bs.done_at_min > 0 for bs in stats)
+
+    def test_p2phandel_sigs_per_time(self, tmp_path):
+        from wittgenstein_tpu.scenarios.oracle_scenarios import (
+            p2phandel_sigs_per_time,
+        )
+
+        out = tmp_path / "sigs.png"
+        g = p2phandel_sigs_per_time(node_ct=64, series=2, out=str(out))
+        assert out.stat().st_size > 10_000
+        # 3 series per run (min/max/avg) x 2 runs
+        assert len(g.series) == 6

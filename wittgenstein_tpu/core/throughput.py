@@ -14,7 +14,9 @@ from .node import Node
 
 
 class NetworkThroughput:
-    def delay(self, from_node: Node, to_node: Node, delta: int, msg_size: int) -> int:
+    def delay(self, from_node: Node, to_node: Node, delta: int, msg_size: int, nl=None) -> int:
+        """`nl` is the owning Network's latency model (Network.transit_ms
+        always passes it); implementations should price off it when given."""
         raise NotImplementedError
 
 

@@ -14,7 +14,7 @@ EthPowState, stacked/replicated variants).
 
 from __future__ import annotations
 
-import io
+
 import os
 from typing import Any
 
@@ -42,12 +42,11 @@ def save_state(state: Any, dest: str) -> None:
     arrays = {}
     for path, leaf in leaves:
         arrays[_path_str(path)] = np.asarray(leaf)
-    buf = io.BytesIO()
-    np.savez_compressed(buf, **arrays)
-    tmp = dest + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(buf.getvalue())
-    os.replace(tmp, dest)  # atomic: never a torn checkpoint
+    # stream straight to a temp file (savez appends .npz when missing),
+    # then atomically replace — never a torn checkpoint, no in-RAM copy
+    tmp = dest + ".tmp.npz"
+    np.savez_compressed(tmp, **arrays)
+    os.replace(tmp, dest)
 
 
 def load_state(template: Any, src: str) -> Any:

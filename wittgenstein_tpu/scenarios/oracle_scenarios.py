@@ -1,0 +1,201 @@
+"""Oracle-side scenario suites: P2PHandelScenarios and
+OptimisticP2PSignatureScenarios (P2PHandelScenarios.java:17-283,
+OptimisticP2PSignatureScenarios.java:15-107).
+
+These protocols run on the oracle engine (no batched twin), so the suites
+keep the reference's RunMultipleTimes shape: `run(rounds, params)` ->
+BasicStats, a node-count scaling battery (logErrors), and the
+signatures-per-time Graph series (sigsPerTime).
+
+    python -m wittgenstein_tpu.scenarios.oracle_scenarios p2phandel-scaling
+    python -m wittgenstein_tpu.scenarios.oracle_scenarios optimistic-scaling
+    python -m wittgenstein_tpu.scenarios.oracle_scenarios p2phandel-sigs --out sigs.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import List, Optional
+
+from ..core import stats as SH
+from ..core.runners import RunMultipleTimes
+from ..tools.graph import Graph, ReportLine, Series
+
+
+@dataclasses.dataclass
+class BasicStats:
+    """(P2PHandelScenarios.BasicStats / OptimisticP2PSignatureScenarios)."""
+
+    done_at_min: int
+    done_at_avg: int
+    done_at_max: int
+    msg_rcv_min: int
+    msg_rcv_avg: int
+    msg_rcv_max: int
+    bytes_rcv_avg: int = 0
+
+    def __str__(self) -> str:
+        return (
+            f"; doneAtAvg={self.done_at_avg}; msgRcvAvg={self.msg_rcv_avg}"
+            f", bytesRcvAvg={self.bytes_rcv_avg}"
+        )
+
+
+class _BytesReceivedGetter(SH.SimpleStatsGetter):
+    def get(self, live_nodes):
+        return SH.get_stats_on(live_nodes, lambda n: n.bytes_received)
+
+
+def run_protocol(protocol, rounds: int) -> BasicStats:
+    """RunMultipleTimes battery with the reference's getters."""
+    getters: List[SH.StatsGetter] = [
+        SH.DoneAtStatGetter(),
+        SH.MsgReceivedStatGetter(),
+        _BytesReceivedGetter(),
+    ]
+    rmt = RunMultipleTimes(protocol, rounds, 0, getters)
+    res = rmt.run(RunMultipleTimes.cont_until_done())
+    return BasicStats(
+        res[0].get("min"),
+        res[0].get("avg"),
+        res[0].get("max"),
+        res[1].get("min"),
+        res[1].get("avg"),
+        res[1].get("max"),
+        res[2].get("avg"),
+    )
+
+
+# -- P2PHandel ---------------------------------------------------------------
+def p2phandel_params(
+    n: int,
+    dead_ratio: float = 0.0,
+    connections: int = 8,
+    threshold: Optional[int] = None,
+    strategy: str = "dif",
+):
+    from ..core.registries import RANDOM, builder_name
+    from ..protocols.p2phandel import P2PHandel, P2PHandelParameters
+
+    params = P2PHandelParameters(
+        signing_node_count=n,
+        relaying_node_count=0,
+        threshold=threshold or int(n * (1 - dead_ratio) * 0.99) or 1,
+        connection_count=connections,
+        pairing_time=3,
+        sigs_send_period=50,
+        double_aggregate_strategy=True,
+        send_sigs_strategy=strategy,
+        send_state=False,
+        node_builder_name=builder_name(RANDOM, True, 0),
+        network_latency_name="NetworkLatencyByDistanceWJitter",
+    )
+    return P2PHandel(params)
+
+
+def p2phandel_scaling(rounds: int = 3, max_nodes: int = 256) -> List[BasicStats]:
+    """logErrors (P2PHandelScenarios.java:81-104): behavior as the node
+    count doubles."""
+    out = []
+    n = 32
+    while n <= max_nodes:
+        bs = run_protocol(p2phandel_params(n), rounds)
+        print(f"{n} nodes: 0.0{bs}")
+        out.append(bs)
+        n *= 2
+    return out
+
+
+def p2phandel_sigs_per_time(
+    node_ct: int = 128, series: int = 3, out: Optional[str] = None
+) -> Graph:
+    """sigsPerTime (P2PHandelScenarios.java:106-180): per-run min/max/avg
+    verified-signature series over time, rendered with Graph.  The
+    reference's configuration: full-threshold, strategy 'all',
+    15 connections (:115-126)."""
+    template = p2phandel_params(node_ct, connections=15, threshold=node_ct, strategy="all")
+    g = Graph(
+        f"number of signatures per time (n={node_ct})",
+        "time in ms",
+        "number of signatures",
+    )
+    for i in range(series):
+        cur_min = Series(f"signatures count - worse node{i}")
+        cur_max = Series(f"signatures count - best node{i}")
+        cur_avg = Series(f"signatures count - average{i}")
+        p = template.copy()
+        p.network().rd.set_seed(i)
+        p.init()
+        while True:
+            p.network().run_ms(10)
+            s = SH.get_stats_on(
+                p.network().all_nodes,
+                lambda n: n.verified_signatures.cardinality(),
+            )
+            cur_min.add_line(ReportLine(p.network().time, s.min))
+            cur_max.add_line(ReportLine(p.network().time, s.max))
+            cur_avg.add_line(ReportLine(p.network().time, s.avg))
+            if s.min == template.params.signing_node_count:
+                break
+            if p.network().time > 60_000:
+                raise RuntimeError("sigsPerTime did not converge")
+        g.add_serie(cur_min)
+        g.add_serie(cur_max)
+        g.add_serie(cur_avg)
+    if out:
+        g.save(out)
+        print(f"wrote {out}")
+    return g
+
+
+# -- OptimisticP2PSignature --------------------------------------------------
+def optimistic_params(n: int):
+    from ..core.registries import RANDOM, builder_name
+    from ..protocols.optimistic_p2p_signature import (
+        OptimisticP2PSignature,
+        OptimisticP2PSignatureParameters,
+    )
+
+    params = OptimisticP2PSignatureParameters(
+        node_count=n,
+        threshold=int(n * 0.99) or 1,
+        connection_count=13,
+        pairing_time=3,
+        node_builder_name=builder_name(RANDOM, True, 0),
+        network_latency_name="NetworkLatencyByDistanceWJitter",
+    )
+    return OptimisticP2PSignature(params)
+
+
+def optimistic_scaling(rounds: int = 3, max_nodes: int = 512) -> List[BasicStats]:
+    """logErrors (OptimisticP2PSignatureScenarios.java:60-85)."""
+    out = []
+    n = 64
+    while n <= max_nodes:
+        bs = run_protocol(optimistic_params(n), rounds)
+        print(f"{n} nodes: 0.0{bs}")
+        out.append(bs)
+        n *= 2
+    return out
+
+
+SCENARIOS = {
+    "p2phandel-scaling": lambda a: p2phandel_scaling(a.rounds, a.nodes),
+    "optimistic-scaling": lambda a: optimistic_scaling(a.rounds, a.nodes),
+    "p2phandel-sigs": lambda a: p2phandel_sigs_per_time(a.nodes, out=a.out),
+}
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("scenario", choices=sorted(SCENARIOS))
+    ap.add_argument("--nodes", type=int, default=256)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--out", default=None)
+    a = ap.parse_args(argv)
+    SCENARIOS[a.scenario](a)
+
+
+if __name__ == "__main__":
+    main()
