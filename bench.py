@@ -135,17 +135,16 @@ def bench_batched(node_ct: int, n_replicas: int) -> dict:
     assert int(out.done_at.min()) > 0, "sim did not converge"
     assert int(out.dropped.max()) == 0, "message ring overflow"
 
+    import contextlib
+
+    from wittgenstein_tpu.tools.profiling import trace
+
     profile_dir = os.environ.get("WITT_BENCH_PROFILE")
-    if profile_dir:
-        jax.profiler.start_trace(profile_dir)
-    try:
+    with trace(profile_dir) if profile_dir else contextlib.nullcontext():
         t0 = time.perf_counter()
         out = run(states)
         jax.block_until_ready(out)
         run_s = time.perf_counter() - t0
-    finally:
-        if profile_dir:
-            jax.profiler.stop_trace()
     return {
         "sims_per_sec": n_replicas / run_s,
         "compile_s": round(compile_s, 1),

@@ -147,3 +147,24 @@ class TestWServer:
         assert get(base_url, "/w/protocols")[0] == 200
         status, _ = post(base_url, "/w/unknown/route")
         assert status == 404
+
+    def test_external_rest_loopback(self, base_url):
+        """ExternalRest round trip against our own /w/external_sink: a node
+        delegated to the demo endpoint keeps the simulation running
+        (reference flow: Network delivery -> ExternalRest PUT ->
+        List[SendMessage], ExternalRest.java:36-59 + ExternalWS.java:22-40)."""
+        _, params = get(base_url, "/w/protocols/PingPong")
+        params["node_ct"] = 20
+        post(base_url, "/w/network/init/PingPong", params)
+        status, _ = post(
+            base_url,
+            "/w/network/nodes/3/external",
+            f"{base_url}/w/external_sink",
+        )
+        assert status == 200
+        _, n3 = get(base_url, "/w/network/nodes/3")
+        assert "ExternalRest" in n3["external"]
+        # run: node 3's deliveries round-trip over HTTP and return no sends
+        assert post(base_url, "/w/network/runMs/400")[0] == 200
+        _, n0 = get(base_url, "/w/network/nodes/0")
+        assert n0["msgReceived"] > 0

@@ -95,3 +95,34 @@ class TestKademlia:
         assert distance(b"\x01\x00", b"\x00\x00") == 9
         # symmetry
         assert distance(b"\x12\x34", b"\x43\x21") == distance(b"\x43\x21", b"\x12\x34")
+
+
+class TestProfiling:
+    def test_trace_and_annotate(self, tmp_path):
+        import jax.numpy as jnp
+
+        from wittgenstein_tpu.tools.profiling import WallClock, annotate, trace
+
+        d = tmp_path / "trace"
+        with trace(str(d)):
+            with annotate("matmul"):
+                x = jnp.ones((64, 64))
+                (x @ x).block_until_ready()
+        produced = list(d.rglob("*"))
+        assert produced, "no trace files written"
+
+        with WallClock() as w:
+            pass
+        assert w.seconds is not None and w.seconds >= 0
+
+    def test_trace_stops_on_error(self, tmp_path):
+        """A failing body must not leave the profiler active (a leaked
+        active profiler poisons every later start_trace)."""
+        from wittgenstein_tpu.tools.profiling import trace
+
+        with pytest.raises(RuntimeError):
+            with trace(str(tmp_path / "t1")):
+                raise RuntimeError("boom")
+        # a second trace works because the first was stopped
+        with trace(str(tmp_path / "t2")):
+            pass

@@ -116,9 +116,11 @@ class WServer:
         self.server.send_message(json.loads(body))
         return {"ok": True}
 
-    @route("PUT", r"/w/external_sink")
+    @route("PUT", r"/w/external_sink", locked=False)
     def external_sink(self, body):
-        # demo endpoint (ws/ExternalWS.java:22-40): log and return no sends
+        # demo endpoint (ws/ExternalWS.java:22-40): log and return no sends.
+        # lock-free: it touches no simulation state, and a node delegated
+        # to OUR OWN sink calls back in while runMs holds the lock
         print(f"external_sink received: {body[:200]}")
         return []
 

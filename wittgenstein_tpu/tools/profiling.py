@@ -1,0 +1,55 @@
+"""Profiling helpers (the SURVEY §5 'tracing/profiling' upgrade — the
+reference's observability is counters + stdout; here device-level traces
+come from jax.profiler).
+
+Usage:
+
+    from wittgenstein_tpu.tools.profiling import trace
+    with trace("/tmp/witt-trace"):
+        out = net.run_ms_batched(states, 1000)
+        jax.block_until_ready(out)
+
+The trace directory opens in TensorBoard's profile plugin / Perfetto.
+`bench.py` exposes the same via WITT_BENCH_PROFILE=<dir>.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """jax.profiler trace over the with-block (always stopped, even on
+    failure — a leaked active profiler poisons every later start_trace)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region inside a trace (shows up on the TraceMe track)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class WallClock:
+    """Tiny host-side timer for compile/run splits (the pattern bench.py
+    uses): `with WallClock() as w: ...; w.seconds`."""
+
+    def __enter__(self) -> "WallClock":
+        self._t0 = time.perf_counter()
+        self.seconds: Optional[float] = None
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.seconds = time.perf_counter() - self._t0
