@@ -24,3 +24,16 @@ jax.config.update("jax_platforms", "cpu")
 _cache = os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop in-process jit executables between modules: a full-suite run
+    otherwise accumulates hundreds of compiled batched-simulation programs
+    (each BatchedNetwork's jit cache holds strong refs) and runs several
+    times slower than the per-module sum.  The persistent on-disk cache
+    keeps recompiles cheap."""
+    yield
+    jax.clear_caches()
