@@ -19,9 +19,17 @@ oracle DES (this repo's exact-semantics port of the reference's Java event
 loop) running the identical configuration once; vs_baseline is the
 speedup: batched sims/sec divided by oracle sims/sec.
 
+Execution is CHUNKED (CHUNK_MS simulated ms per device call, host sync
+between chunks): the tunneled TPU kills any single XLA program running
+longer than its RPC watchdog (~100 s — "TPU worker process crashed"), and
+one 4096-node tick costs ~0.5 s, so a full 1000-tick run must be split.
+Found by bisection in round 3: 512x4x1000 ticks in one call survives,
+1024x4x1000 does not; 1024x4x200 does.
+
 Env knobs:
   WITT_BENCH_PLATFORM=cpu|tpu  skip the probe, force a platform
   WITT_BENCH_REPLICAS=N        override the replica count
+  WITT_BENCH_CHUNK_MS=N        simulated ms per device call (default 100)
   WITT_BENCH_PROFILE=DIR       capture a jax.profiler trace of the timed run
 """
 
@@ -34,6 +42,11 @@ import sys
 import time
 
 SIM_MS = 1000
+CHUNK_MS = int(os.environ.get("WITT_BENCH_CHUNK_MS", "100"))
+if CHUNK_MS <= 0 or SIM_MS % CHUNK_MS != 0:
+    raise SystemExit(
+        f"WITT_BENCH_CHUNK_MS={CHUNK_MS} must be a positive divisor of {SIM_MS}"
+    )
 PROBE_ATTEMPTS = 3
 PROBE_TIMEOUT_S = 150
 
@@ -124,13 +137,27 @@ def bench_batched(node_ct: int, n_replicas: int) -> dict:
     from wittgenstein_tpu.engine import replicate_state
     from wittgenstein_tpu.protocols.handel_batched import make_handel
 
+    # persistent compile cache: the big per-tick graphs take 30-120 s to
+    # compile on the tunneled backend; cache hits skip that on re-runs
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.abspath(os.environ.get("WITT_BENCH_CACHE", ".jax_cache_tpu")),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
     net, state = make_handel(_params(node_ct))
     states = replicate_state(state, n_replicas)
-    run = jax.jit(lambda s: net.run_ms_batched(s, SIM_MS))
+    n_chunks = max(1, SIM_MS // CHUNK_MS)
+    run = jax.jit(lambda s: net.run_ms_batched(s, CHUNK_MS))
+
+    def run_chunked(s):
+        for _ in range(n_chunks):
+            s = run(s)
+            jax.block_until_ready(s)  # keep each device program short
+        return s
 
     t0 = time.perf_counter()
-    out = run(states)  # compile + warmup
-    jax.block_until_ready(out)
+    out = run_chunked(states)  # compile + warmup
     compile_s = time.perf_counter() - t0
     assert int(out.done_at.min()) > 0, "sim did not converge"
     assert int(out.dropped.max()) == 0, "message ring overflow"
@@ -142,8 +169,7 @@ def bench_batched(node_ct: int, n_replicas: int) -> dict:
     profile_dir = os.environ.get("WITT_BENCH_PROFILE")
     with trace(profile_dir) if profile_dir else contextlib.nullcontext():
         t0 = time.perf_counter()
-        out = run(states)
-        jax.block_until_ready(out)
+        out = run_chunked(states)
         run_s = time.perf_counter() - t0
     return {
         "sims_per_sec": n_replicas / run_s,
@@ -166,7 +192,7 @@ def main() -> None:
     device_kind = getattr(devs[0], "device_kind", "?")
 
     if platform == "tpu":
-        ladder = [(4096, 32), (4096, 16), (4096, 8)]
+        ladder = [(4096, 32), (4096, 16), (4096, 8), (1024, 16)]
     else:
         ladder = [(256, 4)]
     if os.environ.get("WITT_BENCH_REPLICAS"):
@@ -210,6 +236,7 @@ def main() -> None:
                     "node_count": node_ct,
                     "n_replicas": n_replicas,
                     "sim_ms": SIM_MS,
+                    "chunk_ms": CHUNK_MS,
                 },
                 "compile_s": result["compile_s"],
                 "run_s": result["run_s"],

@@ -1,0 +1,128 @@
+"""Batched Slush/Snowflake: exact traffic invariants, oracle parity on
+convergence timing, flip dynamics, determinism.
+
+The oracle's traffic is deterministic in aggregate: every node runs exactly
+M+1 query rounds (Slush) of K queries + K answers, so total msg_received is
+nodes*(m+1)*2k regardless of seed — the batched engine must match it
+exactly, not just distributionally."""
+
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.engine import replicate_state
+from wittgenstein_tpu.protocols.avalanche_batched import make_slush, make_snowflake
+from wittgenstein_tpu.protocols.slush import Slush, SlushParameters
+from wittgenstein_tpu.protocols.snowflake import Snowflake, SnowflakeParameters
+
+
+def oracle_all_colored_at(proto_cls, params, seeds, run_ms=2000, step=10):
+    out = []
+    for seed in seeds:
+        o = proto_cls(params)
+        o.network().rd.set_seed(seed)
+        o.init()
+        t_all = None
+        for t in range(0, run_ms, step):
+            o.network().run_ms(step)
+            if t_all is None and all(
+                n.my_color != 0 for n in o.network().all_nodes
+            ):
+                t_all = t + step
+                break
+        out.append(t_all)
+    return np.asarray([t for t in out if t is not None], dtype=float)
+
+
+def batched_all_colored_at(net, state, n_replicas, run_ms=2000, step=10):
+    states = replicate_state(state, n_replicas)
+    t_all = np.full(n_replicas, -1)
+    for t in range(0, run_ms, step):
+        states = net.run_ms_batched(states, step)
+        colored = np.asarray(states.proto["color"]).min(axis=1) > 0
+        t_all = np.where((t_all < 0) & colored, t + step, t_all)
+        if (t_all > 0).all():
+            break
+    return states, t_all
+
+
+class TestBatchedSlush:
+    def test_exact_traffic_and_quiescence(self):
+        """Total received messages == nodes*(m+1)*2k (Slush.java:161-176:
+        every node completes exactly m+1 rounds); no in-flight work left."""
+        p = SlushParameters()
+        net, state = make_slush(p)
+        out = net.run_ms(state, 2000)
+        assert int(np.asarray(out.msg_received).sum()) == p.nodes_av * (p.m + 1) * 2 * p.k
+        assert int(out.dropped) == 0
+        assert bool(net.protocol.all_done(out))
+        it = np.asarray(out.proto["iter"])
+        assert (it == p.m).all()
+
+    def test_oracle_parity_time_to_colored(self):
+        """Median time until every node is colored within 15% of the oracle
+        (10 oracle seeds vs 16 replicas; the spread at 100 nodes is tight)."""
+        p = SlushParameters()
+        o = oracle_all_colored_at(Slush, p, range(10))
+        net, state = make_slush(p)
+        _, b = batched_all_colored_at(net, state, 16)
+        assert (b > 0).all()
+        om, bm = np.median(o), np.median(b)
+        assert abs(bm - om) / om <= 0.15, (om, bm)
+
+    def test_flips_with_low_alpha(self):
+        """With ak < k (the reference main()'s 4/7 alpha) opposing
+        majorities actually flip colors and one color dominates."""
+        p = SlushParameters(nodes_av=100, m=5, k=7, a=4.0 / 7.0)
+        net, state = make_slush(p)
+        states = replicate_state(state, 8)
+        out = net.run_ms_batched(states, 3000)
+        colors = np.asarray(out.proto["color"])
+        assert (colors > 0).all()
+        # dominant color holds a supermajority in most replicas
+        frac = np.maximum(
+            (colors == 1).mean(axis=1), (colors == 2).mean(axis=1)
+        )
+        assert np.median(frac) >= 0.7, frac
+
+    def test_determinism(self):
+        net, state = make_slush(SlushParameters())
+        states = replicate_state(state, 4, seeds=[3, 4, 5, 6])
+        a = net.run_ms_batched(states, 1500)
+        b = net.run_ms_batched(states, 1500)
+        assert (np.asarray(a.proto["color"]) == np.asarray(b.proto["color"])).all()
+        assert len(
+            {tuple(np.asarray(a.proto["color"])[i]) for i in range(4)}
+        ) > 1
+
+
+class TestBatchedSnowflake:
+    def test_converges_and_quiesces(self):
+        """Nodes stop querying once cnt > B (Snowflake.java:170-188)."""
+        p = SnowflakeParameters(nodes_av=100, m=5, k=7, a=4.0 / 7.0, b=3)
+        net, state = make_snowflake(p)
+        out = net.run_ms(state, 4000)
+        assert bool(net.protocol.all_done(out))
+        assert int(out.dropped) == 0
+        it = np.asarray(out.proto["iter"])
+        assert (it == p.b + 1).all()  # everyone exits via cnt > B
+
+    def test_oracle_parity_time_to_colored(self):
+        p = SnowflakeParameters()
+        o = oracle_all_colored_at(Snowflake, p, range(10))
+        net, state = make_snowflake(p)
+        _, b = batched_all_colored_at(net, state, 16)
+        assert (b > 0).all()
+        om, bm = np.median(o), np.median(b)
+        assert abs(bm - om) / om <= 0.15, (om, bm)
+
+    def test_high_alpha_never_flips(self):
+        """Default a=4.0 makes ak=28 > k: flips are impossible, so cnt can
+        only confirm... but a confirming majority needs > 28 of 7 answers
+        too, so cnt stays 0 and nodes query forever (until run_ms ends) —
+        matching the oracle's default-parameter quirk."""
+        p = SnowflakeParameters()
+        net, state = make_snowflake(p)
+        out = net.run_ms(state, 800)
+        it = np.asarray(out.proto["iter"])
+        assert (it == 0).all()
+        assert bool(np.asarray(out.proto["active"]).all())

@@ -1,0 +1,83 @@
+"""Batched Dfinity: chain-progress parity with the oracle, role behavior,
+determinism.  The protocol is open-ended (no doneAt), so the observables
+are head heights and traffic, like the reference's printStat."""
+
+import numpy as np
+
+from wittgenstein_tpu.engine import replicate_state
+from wittgenstein_tpu.oracle.blockchain import Block
+from wittgenstein_tpu.protocols.dfinity import Dfinity, DfinityParameters
+from wittgenstein_tpu.protocols.dfinity_batched import make_dfinity
+
+RUN_MS = 15000
+
+
+def oracle_run(run_ms=RUN_MS):
+    Block.reset_block_ids()
+    o = Dfinity(DfinityParameters())
+    o.init()
+    o.network().run_ms(run_ms)
+    heights = np.array([n.head.height for n in o.network().all_nodes])
+    msgs = sum(n.msg_received for n in o.network().all_nodes)
+    return heights, msgs
+
+
+class TestBatchedDfinity:
+    def test_oracle_parity(self):
+        """All nodes converge to the same head height as the oracle run
+        (the notarized chain advances in lockstep rounds); traffic within
+        5%."""
+        oh, om = oracle_run()
+        net, state = make_dfinity(DfinityParameters(), max_heights=64)
+        out = net.run_ms(state, RUN_MS)
+        bh = np.asarray(net.protocol.head_height(out))
+        assert bh.min() == bh.max(), "chain must be in sync across nodes"
+        assert abs(int(bh.max()) - int(oh.max())) <= 1, (oh.max(), bh.max())
+        bm = int(np.asarray(out.msg_received).sum())
+        assert abs(bm - om) / om <= 0.05, (om, bm)
+        assert int(out.dropped) == 0
+
+    def test_chain_grows_with_time(self):
+        net, state = make_dfinity(DfinityParameters(), max_heights=64)
+        s1 = net.run_ms(state, 7000)
+        h1 = int(np.asarray(net.protocol.head_height(s1)).max())
+        s2 = net.run_ms(s1, 8000)
+        h2 = int(np.asarray(net.protocol.head_height(s2)).max())
+        assert h1 >= 1
+        assert h2 > h1
+
+    def test_block_table_consistency(self):
+        """Every adopted head exists in the block table and its parent
+        chain walks back to genesis with strictly decreasing heights."""
+        net, state = make_dfinity(DfinityParameters(), max_heights=64)
+        out = net.run_ms(state, RUN_MS)
+        proto = out.proto
+        exists = np.asarray(proto["blk_exists"])
+        parent = np.asarray(proto["blk_parent"])
+        n_bp = net.protocol.n_bp
+        for hs in np.asarray(proto["head_slot"]):
+            steps = 0
+            while hs >= 0:
+                assert exists[hs]
+                par = parent[hs]
+                if par >= 0:
+                    assert par // n_bp < hs // n_bp  # height decreases
+                hs = par
+                steps += 1
+                assert steps < 100
+
+    def test_replicas_and_determinism(self):
+        net, state = make_dfinity(DfinityParameters(), max_heights=64)
+        states = replicate_state(state, 4, seeds=[1, 2, 3, 4])
+        a = net.run_ms_batched(states, 9000)
+        ha = np.asarray(jnp_max_heights(net, a))
+        assert (ha >= 1).all()
+        b = net.run_ms_batched(states, 9000)
+        hb = np.asarray(jnp_max_heights(net, b))
+        assert (ha == hb).all()
+
+
+def jnp_max_heights(net, states):
+    import jax
+
+    return jax.vmap(lambda s: net.protocol.head_height(s).max())(states)

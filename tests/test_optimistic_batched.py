@@ -1,0 +1,69 @@
+"""Batched OptimisticP2PSignature: convergence, oracle parity (the flood
+over the same P2P graph gives near-identical done times), done-guard
+semantics, determinism."""
+
+import numpy as np
+
+from wittgenstein_tpu.engine import replicate_state
+from wittgenstein_tpu.protocols.optimistic_p2p_signature import (
+    OptimisticP2PSignature,
+    OptimisticP2PSignatureParameters,
+)
+from wittgenstein_tpu.protocols.optimistic_p2p_signature_batched import make_optimistic
+
+
+def make_params(**kw):
+    base = dict(node_count=64, threshold=56, connection_count=10, pairing_time=3)
+    base.update(kw)
+    return OptimisticP2PSignatureParameters(**base)
+
+
+class TestBatchedOptimistic:
+    def test_converges_and_parity(self):
+        """Same P2P graph as the oracle (identical topology via the shared
+        JavaRandom stream) → median doneAt within 5% and message totals
+        within 3% (the only delta is same-tick forwarding races)."""
+        p = make_params()
+        o = OptimisticP2PSignature(p)
+        o.init()
+        o.network().run_ms(1500)
+        od = np.array([n.done_at for n in o.network().all_nodes])
+        assert (od > 0).all()
+        omsgs = sum(n.msg_received for n in o.network().all_nodes)
+
+        net, state = make_optimistic(p)
+        out = net.run_ms(state, 1500)
+        bd = np.asarray(out.done_at)
+        assert (bd > 0).all()
+        assert int(out.dropped) == 0
+        assert bool(net.protocol.all_done(out))
+        assert abs(np.median(bd) - np.median(od)) / np.median(od) <= 0.05
+        bmsgs = int(np.asarray(out.msg_received).sum())
+        assert abs(bmsgs - omsgs) / omsgs <= 0.03, (omsgs, bmsgs)
+
+    def test_done_at_offset(self):
+        """doneAt = crossing time + 2*pairingTime
+        (OptimisticP2PSignature.java:131): raising pairing_time shifts every
+        doneAt by exactly the same delta on the same seed."""
+        p1, p2 = make_params(pairing_time=1), make_params(pairing_time=10)
+        net1, s1 = make_optimistic(p1)
+        net2, s2 = make_optimistic(p2)
+        d1 = np.asarray(net1.run_ms(s1, 1500).done_at)
+        d2 = np.asarray(net2.run_ms(s2, 1500).done_at)
+        assert ((d2 - d1) == 18).all()
+
+    def test_sig_counts_reach_threshold(self):
+        net, state = make_optimistic(make_params())
+        out = net.run_ms(state, 1500)
+        counts = np.asarray(out.proto["received"]).sum(axis=1)
+        assert (counts >= net.protocol.params.threshold).all()
+
+    def test_replicas_and_determinism(self):
+        net, state = make_optimistic(make_params())
+        states = replicate_state(state, 4, seeds=[7, 8, 9, 10])
+        a = net.run_ms_batched(states, 1500)
+        done = np.asarray(a.done_at)
+        assert (done > 0).all()
+        assert len({tuple(done[i]) for i in range(4)}) > 1
+        b = net.run_ms_batched(states, 1500)
+        assert (np.asarray(b.done_at) == done).all()

@@ -1,0 +1,201 @@
+"""Batched Avalanche family (Slush / Snowflake): repeated random sampling
+with an alpha threshold, as vectorized per-tick kernels.
+
+Reference semantics: protocols/Slush.java and protocols/Snowflake.java —
+the shared Query/AnswerQuery machinery (Slush.java:86-220 ==
+Snowflake.java:95-232) plus the per-protocol onAnswer accounting
+(Slush.java:161-176 round/M; Snowflake.java:170-188 cnt/B).
+
+Design notes (TPU-first, not a port):
+
+  * a node has AT MOST ONE query in flight (send_query fires only at color
+    adoption or when the previous query's K answers are all in), so the
+    per-node answer book `answer_ip` collapses to two counter columns
+    `cf[N, 3]` plus an `active[N]` mask — no map, no query ids;
+  * `random_remotes`' rejection loop (K distinct uniform picks,
+    Slush.java:126-137) becomes `top_k` over per-(node, nonce) hashed
+    random keys with the self-key pinned to INT32_MIN: an exact
+    sample-without-replacement, drawn in one shot for every querying node;
+  * same-tick query adoption races resolve by lowest ring slot (the oracle
+    processes them in LIFO ms order; documented ordering delta of the
+    batched engine) — all same-tick queries are answered with the
+    post-adoption color.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.node import build_node_columns
+from ..core.registries import registry_network_latencies
+from ..engine import BatchedNetwork, BatchedProtocol, Emission
+from ..engine.rng import hash32
+from .slush import Slush, SlushParameters
+from .snowflake import Snowflake, SnowflakeParameters
+
+INT32_MIN = jnp.int32(-(2**31))
+
+
+class BatchedAvalanche(BatchedProtocol):
+    """Shared engine for both protocols; `mode` picks the onAnswer rule."""
+
+    MSG_TYPES = ["QUERY", "ANSWER"]
+    PAYLOAD_WIDTH = 1  # the sender's color
+    TICK_INTERVAL = None  # pure message protocol: engine may skip empty ms
+
+    def __init__(self, params, mode: str):
+        assert mode in ("slush", "snowflake")
+        self.params = params
+        self.mode = mode
+        self.n_nodes = params.nodes_av
+        self.k = params.k
+        self.ak = params.ak
+
+    def proto_init(self, n_nodes: int):
+        color = jnp.zeros(n_nodes, jnp.int32)
+        # init_two_colors (Slush.java:62-74): node 0 red, node 1 blue, both
+        # with a query in flight from t=0
+        color = color.at[0].set(1).at[1].set(2)
+        active = jnp.zeros(n_nodes, bool).at[0].set(True).at[1].set(True)
+        return {
+            "color": color,
+            "iter": jnp.zeros(n_nodes, jnp.int32),  # Slush round / Snowflake cnt
+            "active": active,
+            "cf": jnp.zeros((n_nodes, 3), jnp.int32),  # answers by color
+            "nonce": jnp.zeros(n_nodes, jnp.int32),  # per-node query counter
+        }
+
+    # -- K distinct random remotes (Slush.java:126-137) ----------------------
+    def _query_emission(self, state, start, color, nonce):
+        """Emission: every node in `start` queries K distinct uniform
+        remotes (excluding itself) with its current color."""
+        n, k = self.n_nodes, self.k
+        rows = jnp.arange(n, dtype=jnp.int32)
+        keys = hash32(
+            state.seed, jnp.int32(7701), rows[:, None], nonce[:, None],
+            jnp.arange(n, dtype=jnp.int32)[None, :],
+        )
+        keys = keys.at[rows, rows].set(INT32_MIN)  # never sample self
+        _, picks = jax.lax.top_k(keys, k)  # [N, K] distinct ids
+        return Emission(
+            mask=jnp.repeat(start, k),
+            from_idx=jnp.repeat(rows, k),
+            to_idx=picks.reshape(-1).astype(jnp.int32),
+            mtype=self.mtype("QUERY"),
+            payload=jnp.repeat(color, k)[:, None],
+        )
+
+    def initial_emissions(self, net, state):
+        p = state.proto
+        return [self._query_emission(state, p["active"], p["color"], p["nonce"])]
+
+    def deliver(self, net, state, deliver_mask):
+        p = self.params
+        proto = state.proto
+        c = deliver_mask.shape[0]
+        to, frm = state.msg_to, state.msg_from
+        pay_color = state.msg_payload[:, 0]
+        is_q = deliver_mask & (state.msg_type == self.mtype("QUERY"))
+        is_a = deliver_mask & (state.msg_type == self.mtype("ANSWER"))
+
+        # -- on_query: uncolored nodes adopt the winning (lowest-slot)
+        # query's color and start their own query (Slush.java:141-148)
+        color = proto["color"]
+        slot = jnp.arange(c, dtype=jnp.int32)
+        win = jnp.full(self.n_nodes, c, jnp.int32)
+        win = win.at[to].min(
+            jnp.where(is_q & (color[to] == 0), slot, c), mode="drop"
+        )
+        adopts = win < c
+        win_color = pay_color[jnp.clip(win, 0, c - 1)]
+        color = jnp.where(adopts & (color == 0), win_color, color)
+
+        # every query is answered with the (post-adoption) current color
+        em_answer = Emission(
+            mask=is_q,
+            from_idx=to,
+            to_idx=frm,
+            mtype=self.mtype("ANSWER"),
+            payload=color[to][:, None],
+        )
+
+        # -- on_answer accounting: count answers for the active query
+        cf = proto["cf"]
+        cf = cf.at[to, jnp.clip(pay_color, 0, 2)].add(
+            is_a.astype(jnp.int32), mode="drop"
+        )
+        it = proto["iter"]
+        active = proto["active"]
+        complete = active & ((cf[:, 1] + cf[:, 2]) >= p.k)
+        other = jnp.where(color == 1, 2, 1).astype(jnp.int32)
+        rows = jnp.arange(self.n_nodes)
+        cf_other = cf[rows, other]
+        cf_mine = cf[rows, jnp.clip(color, 0, 2)]
+        flip = complete & (cf_other > p.ak)
+        if self.mode == "slush":
+            # Slush.java:161-176: flip on opposing majority; requery while
+            # round < M
+            cont = complete & (it < p.m)
+            it = jnp.where(cont, it + 1, it)
+        else:
+            # Snowflake.java:170-188: flip resets cnt, confirming majority
+            # increments it; requery while cnt <= B
+            confirm = complete & ~flip & (cf_mine > p.ak)
+            it = jnp.where(flip, 0, jnp.where(confirm, it + 1, it))
+            cont = complete & (it <= p.b)
+        color = jnp.where(flip, other, color)
+
+        start = cont | adopts
+        nonce = proto["nonce"] + start.astype(jnp.int32)
+        em_query = self._query_emission(state, start, color, nonce)
+        active = (active & ~complete) | start
+        cf = jnp.where(complete[:, None], 0, cf)
+
+        state = state._replace(
+            proto={
+                "color": color,
+                "iter": it,
+                "active": active,
+                "cf": cf,
+                "nonce": nonce,
+            }
+        )
+        return state, [em_answer, em_query]
+
+    def all_done(self, state):
+        p = state.proto
+        return jnp.all(p["color"] > 0) & ~jnp.any(p["active"])
+
+
+def _make(oracle_cls, params, mode: str, capacity: int, seed: int):
+    """Host-side construction: build the oracle's node layout (same builder
+    RNG stream → same position/latency distribution), bake into the engine."""
+    oracle = oracle_cls(params)
+    oracle.init()
+    net_o = oracle.network()
+    latency = registry_network_latencies.get_by_name(params.network_latency_name)
+    city_index = getattr(latency, "city_index", None)
+    cols = build_node_columns(net_o.all_nodes, city_index)
+    proto = BatchedAvalanche(params, mode)
+    net = BatchedNetwork(proto, latency, params.nodes_av, capacity=capacity)
+    state = net.init_state(
+        cols, seed=seed, proto=proto.proto_init(params.nodes_av)
+    )
+    return net, state
+
+
+def make_slush(
+    params: Optional[SlushParameters] = None, capacity: int = 1 << 12, seed: int = 0
+):
+    return _make(Slush, params or SlushParameters(), "slush", capacity, seed)
+
+
+def make_snowflake(
+    params: Optional[SnowflakeParameters] = None,
+    capacity: int = 1 << 12,
+    seed: int = 0,
+):
+    return _make(Snowflake, params or SnowflakeParameters(), "snowflake", capacity, seed)

@@ -1,0 +1,473 @@
+"""Batched Dfinity: the three-role random-beacon consensus on the batched
+engine — block producers, attester committees, and beacon nodes driving a
+notarized chain with 3-second rounds.
+
+Reference semantics: protocols/Dfinity.java (comparator :107-130, messages
+:132-186, BlockProducerNode :215-263, AttesterNode :265-351,
+RandomBeaconNode :353-424, init :426-450), via the oracle port
+`protocols/dfinity.py`.
+
+TPU-first design:
+
+  * the block DAG is a **preallocated block table** (SURVEY §7 step 7): a
+    block's identity is its (height, producer) pair — each producer
+    proposes at most once per height (BlockProducerNode.onRandomBeaconOnce
+    guards on head.height == h-1 and the last_random_beacon once-guard) —
+    so slot = (height-1) * n_bp + producer fixes every shape at
+    `max_heights * n_bp` slots with (exists, proposal_time, parent) columns;
+  * the Dfinity comparator collapses to height-with-incumbent-ties: the
+    hasDirectLink branch only fires when heights differ, where it agrees
+    with the height rule, and equal heights return 0 (the reference's
+    producer-vs-itself quirk, Dfinity.java:128-129) — so fork choice is a
+    scatter-max of (height, -slot) keys, no ancestor walks;
+  * vote / beacon-exchange sets collapse to COUNTERS: every attester votes
+    at most once per block and every beacon exchanges at most once per
+    height (both structurally, on the sender side), so the receiver-side
+    dedup sets of the reference are reachable by count alone (+ a
+    self-vote / self-exchange flag);
+  * all timing is message-driven (TICK_INTERVAL None): the reference's
+    far-future beacon re-exchange (wt = parent.proposalTime + 2*roundTime,
+    Dfinity.java:396-405) is an Emission with an explicit future
+    send_time, and the engine's empty-ms jump skips the dead time.
+
+Same-tick semantics deltas (documented engine-wide): same-ms deliveries
+are simultaneous; a beacon advances at most one height per tick (the
+oracle can chain two notarized blocks in one ms — unobserved in practice
+since consecutive notarizations are latency-separated).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.node import build_node_columns
+from ..core.registries import registry_network_latencies
+from ..engine import BatchedNetwork, BatchedProtocol, Emission
+from .dfinity import (
+    AttesterNode,
+    BlockProducerNode,
+    Dfinity,
+    DfinityParameters,
+    RandomBeaconNode,
+)
+
+
+class BatchedDfinity(BatchedProtocol):
+    MSG_TYPES = ["PROPOSAL", "VOTE", "RBE", "RBR", "SEND_BLOCK"]
+    PAYLOAD_WIDTH = 2  # (block slot | height, rd)
+    TICK_INTERVAL = None  # pure message protocol
+
+    def __init__(self, params: DfinityParameters, roles: dict, max_heights: int):
+        self.params = params
+        self.max_heights = max_heights
+        self.n_att = params.attesters_count
+        self.n_bp = params.block_producers_count
+        self.n_bcn = params.random_beacon_count
+        self.n_nodes = 1 + self.n_att + self.n_bp + self.n_bcn  # + observer
+        self.max_b = max_heights * self.n_bp
+        # static role columns
+        self.is_att = jnp.asarray(roles["is_att"])
+        self.is_bp = jnp.asarray(roles["is_bp"])
+        self.is_bcn = jnp.asarray(roles["is_bcn"])
+        self.my_round = jnp.asarray(roles["my_round"], jnp.int32)
+        self.bp_local = jnp.asarray(roles["bp_local"], jnp.int32)  # -1 if not BP
+        self.att_ids = jnp.asarray(roles["att_ids"], jnp.int32)  # [n_att]
+        self.bp_ids = jnp.asarray(roles["bp_ids"], jnp.int32)
+        self.bcn_ids = jnp.asarray(roles["bcn_ids"], jnp.int32)
+        self.all_ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
+
+    def proto_init(self, n_nodes: int):
+        n, mb, mh = self.n_nodes, self.max_b, self.max_heights
+        zi = lambda s: jnp.zeros(s, jnp.int32)
+        return {
+            "blk_exists": jnp.zeros(mb, bool),
+            "blk_time": zi(mb),
+            "blk_parent": jnp.full(mb, -1, jnp.int32),
+            "seen": jnp.zeros((n, mb), bool),
+            "head_slot": jnp.full(n, -1, jnp.int32),  # -1 = genesis
+            "cm_blk": jnp.zeros((n, mb), bool),
+            "cm_h": jnp.zeros((n, mh + 2), bool),
+            "last_beacon": zi(n),
+            "vote_for_h": jnp.full(n, -1, jnp.int32),
+            "self_voted": jnp.zeros((n, mb), bool),
+            "vote_cnt": zi((n, mb)),
+            "prop_buf": jnp.zeros((n, mb), bool),
+            # beacon state (send_rb already pre-applied for t=0 init)
+            "bcn_height": jnp.ones(n, jnp.int32),
+            "bcn_last_sent": jnp.ones(n, jnp.int32),
+            "exch_cnt": zi((n, mh + 2)),
+            "exch_self": jnp.zeros((n, mh + 2), bool),
+        }
+
+    # -- helpers -------------------------------------------------------------
+    def _slot_h(self, slot):
+        return slot // self.n_bp + 1
+
+    def _head_h(self, head_slot):
+        return jnp.where(head_slot < 0, 0, self._slot_h(head_slot))
+
+    def initial_emissions(self, net, state):
+        """init (Dfinity.java:426-450): every beacon node send_rb()s the
+        height-1 beacon to all nodes at t + attestation_construction_time."""
+        p = self.params
+        k = self.n_bcn * self.n_nodes
+        frm = jnp.repeat(self.bcn_ids, self.n_nodes)
+        to = jnp.tile(self.all_ids, self.n_bcn)
+        return [
+            Emission(
+                mask=jnp.ones(k, bool),
+                from_idx=frm,
+                to_idx=to,
+                mtype=self.mtype("RBR"),
+                payload=jnp.stack(
+                    [jnp.ones(k, jnp.int32), jnp.ones(k, jnp.int32)], axis=1
+                ),
+                send_time=jnp.full(k, p.attestation_construction_time, jnp.int32),
+            )
+        ]
+
+    # -- the whole protocol runs in deliver ----------------------------------
+    def deliver(self, net, state, deliver_mask):
+        p = self.params
+        proto = dict(state.proto)
+        n, mb, mh = self.n_nodes, self.max_b, self.max_heights
+        t = state.time
+        ids = self.all_ids
+        to, frm = state.msg_to, state.msg_from
+        pay0 = jnp.clip(state.msg_payload[:, 0], 0, mb - 1)
+        payh = jnp.clip(state.msg_payload[:, 0], 0, mh + 1)
+        pay1 = state.msg_payload[:, 1]
+        emissions = []
+
+        is_prop = deliver_mask & (state.msg_type == self.mtype("PROPOSAL"))
+        is_vote = deliver_mask & (state.msg_type == self.mtype("VOTE"))
+        is_rbe = deliver_mask & (state.msg_type == self.mtype("RBE"))
+        is_rbr = deliver_mask & (state.msg_type == self.mtype("RBR"))
+        is_sblk = deliver_mask & (state.msg_type == self.mtype("SEND_BLOCK"))
+
+        # ---- A. block arrivals (on_block, BlockChainNode + roles) ---------
+        new_blk = jnp.zeros((n, mb), bool).at[to, pay0].max(is_sblk, mode="drop")
+        new_blk = new_blk & ~proto["seen"] & proto["blk_exists"][None, :]
+        proto["seen"] = proto["seen"] | new_blk
+
+        # fork choice: height-with-incumbent-ties (comparator :107-130)
+        slots = jnp.arange(mb, dtype=jnp.int32)
+        h_of = self._slot_h(slots)  # [mb]
+        key = jnp.where(new_blk, h_of[None, :] * (mb + 1) + (mb - slots[None, :]), -1)
+        best_key = jnp.max(key, axis=1)
+        best_slot = jnp.where(
+            best_key >= 0, mb - (best_key % (mb + 1)), -1
+        ).astype(jnp.int32)
+        best_h = jnp.where(best_key >= 0, best_key // (mb + 1), 0)
+        cur_h = self._head_h(proto["head_slot"])
+        adopt = best_h > cur_h
+        proto["head_slot"] = jnp.where(adopt, best_slot, proto["head_slot"])
+        head_h = self._head_h(proto["head_slot"])
+
+        # attester on_block (:229-236): committee sets + vote reset
+        att_new = new_blk & self.is_att[:, None]
+        proto["cm_blk"] = proto["cm_blk"] | att_new
+        got_h = jnp.zeros((n, mh + 2), bool).at[
+            jnp.repeat(ids, mb).reshape(n, mb),
+            jnp.broadcast_to(h_of[None, :], (n, mb)),
+        ].max(att_new, mode="drop")
+        proto["cm_h"] = proto["cm_h"] | got_h
+        vreset = jnp.any(
+            att_new & (h_of[None, :] == proto["vote_for_h"][:, None]), axis=1
+        )
+        proto["vote_for_h"] = jnp.where(vreset, -1, proto["vote_for_h"])
+
+        # beacon on_block (:387-410): height advance + exchange/send_rb
+        bcn_adv = self.is_bcn & jnp.any(new_blk, axis=1) & (head_h == proto["bcn_height"])
+        nh = jnp.clip(proto["bcn_height"] + 1, 0, mh + 1)
+        proto["bcn_height"] = jnp.where(bcn_adv, nh, proto["bcn_height"])
+        h_idx = jnp.where(bcn_adv, nh, 0)
+        not_self = ~proto["exch_self"][ids, h_idx]
+        add_self = bcn_adv & not_self
+        proto["exch_self"] = proto["exch_self"].at[ids, h_idx].max(add_self, mode="drop")
+        proto["exch_cnt"] = proto["exch_cnt"].at[ids, h_idx].add(
+            add_self.astype(jnp.int32), mode="drop"
+        )
+        rb_now_a = add_self & (proto["exch_cnt"][ids, h_idx] >= p.majority)
+        # not enough exchanges yet: schedule RandomBeaconExchange(newH) to
+        # the beacon committee at wt = head.parent.proposalTime + 2*roundTime
+        need_exch = bcn_adv & ~rb_now_a
+        par = proto["blk_parent"][jnp.clip(proto["head_slot"], 0, mb - 1)]
+        par_time = jnp.where(
+            proto["head_slot"] < 0,
+            0,
+            jnp.where(par < 0, 0, proto["blk_time"][jnp.clip(par, 0, mb - 1)]),
+        )
+        wt = par_time + 2 * p.round_time
+        wt = jnp.where(wt <= t, t + p.attestation_construction_time, wt)
+        kbb = self.n_bcn * self.n_bcn
+        emissions.append(
+            Emission(
+                mask=jnp.repeat(need_exch[self.bcn_ids], self.n_bcn),
+                from_idx=jnp.repeat(self.bcn_ids, self.n_bcn),
+                to_idx=jnp.tile(self.bcn_ids, self.n_bcn),
+                mtype=self.mtype("RBE"),
+                payload=jnp.stack(
+                    [
+                        jnp.repeat(nh[self.bcn_ids], self.n_bcn),
+                        jnp.zeros(kbb, jnp.int32),
+                    ],
+                    axis=1,
+                ),
+                send_time=jnp.repeat(wt[self.bcn_ids], self.n_bcn),
+            )
+        )
+
+        # ---- B. beacon results (on_random_beacon, :133-140) ---------------
+        rbr_h = jnp.zeros(n, jnp.int32).at[to].max(
+            jnp.where(is_rbr, payh, 0), mode="drop"
+        )
+        trig = rbr_h > proto["last_beacon"]
+        # rd == height for every beacon (send_rb :274-279), so rd = rbr_h
+        rd = rbr_h
+        proto["last_beacon"] = jnp.where(trig, rbr_h, proto["last_beacon"])
+
+        # BP: propose when selected and the parent is in hand (:177-181)
+        bp_sel = (
+            trig
+            & self.is_bp
+            & (rd % p.block_producers_round == self.my_round)
+            & (head_h == rbr_h - 1)
+            & (rbr_h <= mh)
+        )
+        new_slot = jnp.clip((rbr_h - 1) * self.n_bp + self.bp_local, 0, mb - 1)
+        w_slot = jnp.where(bp_sel, new_slot, mb)
+        proto["blk_exists"] = proto["blk_exists"].at[w_slot].set(True, mode="drop")
+        proto["blk_time"] = proto["blk_time"].at[w_slot].set(t, mode="drop")
+        proto["blk_parent"] = proto["blk_parent"].at[w_slot].set(
+            proto["head_slot"], mode="drop"
+        )
+        kpa = self.n_bp * self.n_att
+        emissions.append(
+            Emission(
+                mask=jnp.repeat(bp_sel[self.bp_ids], self.n_att),
+                from_idx=jnp.repeat(self.bp_ids, self.n_att),
+                to_idx=jnp.tile(self.att_ids, self.n_bp),
+                mtype=self.mtype("PROPOSAL"),
+                payload=jnp.stack(
+                    [
+                        jnp.repeat(new_slot[self.bp_ids], self.n_att),
+                        jnp.zeros(kpa, jnp.int32),
+                    ],
+                    axis=1,
+                ),
+                send_time=jnp.full(kpa, 1, jnp.int32) * (t + p.block_construction_time),
+            )
+        )
+
+        # attester committee selection (:238-253)
+        att_sel = (
+            trig
+            & self.is_att
+            & (rd % p.attesters_round == self.my_round)
+            & ~proto["cm_h"][ids, jnp.clip(rbr_h, 0, mh + 1)]
+        )
+        proto["vote_for_h"] = jnp.where(att_sel, rbr_h, proto["vote_for_h"])
+
+        # beacon: adopt a beacon someone else finished (:308-313)
+        bcn_fwd = trig & self.is_bcn & (rbr_h > proto["bcn_height"])
+        proto["bcn_last_sent"] = jnp.where(
+            bcn_fwd, proto["bcn_height"], proto["bcn_last_sent"]
+        )
+        proto["bcn_height"] = jnp.where(bcn_fwd, rbr_h, proto["bcn_height"])
+
+        # ---- C+D. proposals (arrived + unbuffered) and votes --------------
+        prop_ev = jnp.zeros((n, mb), bool).at[to, pay0].max(is_prop, mode="drop")
+        # onRandomBeaconOnce replays buffered proposals at the new height
+        # then clears the buffer (:243-253)
+        at_vh = h_of[None, :] == proto["vote_for_h"][:, None]
+        prop_ev = prop_ev | (att_sel[:, None] & proto["prop_buf"] & at_vh)
+        proto["prop_buf"] = jnp.where(att_sel[:, None], False, proto["prop_buf"])
+
+        votable = self.is_att[:, None] & at_vh
+        do_vote = prop_ev & votable & ~proto["self_voted"]
+        proto["self_voted"] = proto["self_voted"] | do_vote
+        # buffer future proposals (:225-227)
+        buf = prop_ev & self.is_att[:, None] & ~votable & (
+            h_of[None, :] > self._head_h(proto["head_slot"])[:, None]
+        )
+        proto["prop_buf"] = proto["prop_buf"] | buf
+
+        # the broadcast includes the sender (send_all semantics); the oracle
+        # drops the self copy via its voter set ('voter not in voters',
+        # :197-199) — here the self vote is already counted by do_vote
+        vote_ev = jnp.zeros((n, mb), jnp.int32).at[to, pay0].add(
+            (is_vote & (frm != to)).astype(jnp.int32), mode="drop"
+        )
+        vote_ev = jnp.where(votable, vote_ev, 0)  # on_vote height guard (:194-200)
+        proto["vote_cnt"] = proto["vote_cnt"] + vote_ev + do_vote.astype(jnp.int32)
+
+        # majority crossings -> notarize ONE block per attester (:202-206)
+        crossing = votable & (proto["vote_cnt"] >= p.majority) & (
+            do_vote | (vote_ev > 0)
+        )
+        cross_key = jnp.where(crossing, mb - slots[None, :], 0)
+        cw = jnp.argmax(cross_key, axis=1).astype(jnp.int32)
+        has_cross = jnp.max(cross_key, axis=1) > 0
+        proto["cm_blk"] = proto["cm_blk"].at[ids, cw].max(has_cross, mode="drop")
+        proto["cm_h"] = proto["cm_h"].at[
+            ids, jnp.clip(self._slot_h(cw), 0, mh + 1)
+        ].max(has_cross, mode="drop")
+        proto["vote_for_h"] = jnp.where(has_cross, -1, proto["vote_for_h"])
+        knn = self.n_att * self.n_nodes
+        emissions.append(
+            Emission(
+                mask=jnp.repeat(has_cross[self.att_ids], self.n_nodes),
+                from_idx=jnp.repeat(self.att_ids, self.n_nodes),
+                to_idx=jnp.tile(self.all_ids, self.n_att),
+                mtype=self.mtype("SEND_BLOCK"),
+                payload=jnp.stack(
+                    [
+                        jnp.repeat(cw[self.att_ids], self.n_nodes),
+                        jnp.zeros(knn, jnp.int32),
+                    ],
+                    axis=1,
+                ),
+            )
+        )
+
+        # non-crossing self-votes broadcast Vote to the committee (:216-224);
+        # once an attester notarizes, its remaining same-tick votes are
+        # dropped (the oracle's sequential processing stops at _send_block's
+        # voteForHeight reset)
+        vote_out = do_vote & ~has_cross[:, None]
+        for j in range(self.n_bp):
+            # at most one votable height per attester -> n_bp candidate slots
+            vh = jnp.clip(proto["vote_for_h"], 1, mh)
+            sl = jnp.clip((vh - 1) * self.n_bp + j, 0, mb - 1)
+            m = vote_out[ids, sl] & self.is_att
+            kaa = self.n_att * self.n_att
+            emissions.append(
+                Emission(
+                    mask=jnp.repeat(m[self.att_ids], self.n_att),
+                    from_idx=jnp.repeat(self.att_ids, self.n_att),
+                    to_idx=jnp.tile(self.att_ids, self.n_att),
+                    mtype=self.mtype("VOTE"),
+                    payload=jnp.stack(
+                        [
+                            jnp.repeat(sl[self.att_ids], self.n_att),
+                            jnp.zeros(kaa, jnp.int32),
+                        ],
+                        axis=1,
+                    ),
+                    send_time=jnp.full(
+                        kaa, 1, jnp.int32
+                    ) * (t + p.attestation_construction_time),
+                )
+            )
+
+        # ---- E. beacon exchanges (:266-272) -------------------------------
+        # self copy dropped: the sender added itself at height advance
+        # (exchanged set dedup, Dfinity.java:268-271)
+        rbe_ok = (
+            is_rbe
+            & (frm != to)
+            & self.is_bcn[to]
+            & (payh >= proto["bcn_height"][to])
+            & (payh > proto["bcn_last_sent"][to])
+        )
+        proto["exch_cnt"] = proto["exch_cnt"].at[to, payh].add(
+            rbe_ok.astype(jnp.int32), mode="drop"
+        )
+        rb_now_b = (
+            self.is_bcn
+            & (
+                proto["exch_cnt"][ids, jnp.clip(proto["bcn_height"], 0, mh + 1)]
+                >= p.majority
+            )
+            & (proto["bcn_height"] > proto["bcn_last_sent"])
+            & (
+                jnp.zeros(n, bool).at[to].max(rbe_ok, mode="drop")
+                | rb_now_a
+            )
+        )
+        proto["bcn_last_sent"] = jnp.where(
+            rb_now_b, proto["bcn_height"], proto["bcn_last_sent"]
+        )
+        kbn = self.n_bcn * self.n_nodes
+        emissions.append(
+            Emission(
+                mask=jnp.repeat(rb_now_b[self.bcn_ids], self.n_nodes),
+                from_idx=jnp.repeat(self.bcn_ids, self.n_nodes),
+                to_idx=jnp.tile(self.all_ids, self.n_bcn),
+                mtype=self.mtype("RBR"),
+                payload=jnp.stack(
+                    [
+                        jnp.repeat(proto["bcn_height"][self.bcn_ids], self.n_nodes),
+                        jnp.repeat(proto["bcn_height"][self.bcn_ids], self.n_nodes),
+                    ],
+                    axis=1,
+                ),
+                send_time=jnp.full(
+                    kbn, 1, jnp.int32
+                ) * (t + p.attestation_construction_time),
+            )
+        )
+
+        return state._replace(proto=proto), emissions
+
+    def all_done(self, state):
+        return jnp.asarray(False)  # Dfinity runs open-ended, like the oracle
+
+    def head_height(self, state):
+        """Per-node head height (the print_stat observable)."""
+        return self._head_h(state.proto["head_slot"])
+
+
+def make_dfinity(
+    params: Optional[DfinityParameters] = None,
+    max_heights: int = 64,
+    capacity: int = 1 << 13,
+    seed: int = 0,
+    latency_name: Optional[str] = None,
+):
+    """Host-side construction: the oracle builds the node population (same
+    RNG stream — observer, attesters, producers, beacons in id order)."""
+    params = params or DfinityParameters()
+    oracle = Dfinity(params)
+    oracle.init()
+    net_o = oracle.network()
+    nodes = net_o.all_nodes
+    n = len(nodes)
+
+    roles = {
+        "is_att": np.array([isinstance(nd, AttesterNode) for nd in nodes]),
+        "is_bp": np.array([isinstance(nd, BlockProducerNode) for nd in nodes]),
+        "is_bcn": np.array([isinstance(nd, RandomBeaconNode) for nd in nodes]),
+        "my_round": np.array(
+            [getattr(nd, "my_round", 0) for nd in nodes], dtype=np.int32
+        ),
+        "bp_local": np.full(n, -1, dtype=np.int32),
+        "att_ids": np.array(
+            [nd.node_id for nd in nodes if isinstance(nd, AttesterNode)],
+            dtype=np.int32,
+        ),
+        "bp_ids": np.array(
+            [nd.node_id for nd in nodes if isinstance(nd, BlockProducerNode)],
+            dtype=np.int32,
+        ),
+        "bcn_ids": np.array(
+            [nd.node_id for nd in nodes if isinstance(nd, RandomBeaconNode)],
+            dtype=np.int32,
+        ),
+    }
+    for j, nid in enumerate(roles["bp_ids"]):
+        roles["bp_local"][nid] = j
+
+    # the reference never applies networkLatencyName (Dfinity.java:86-90);
+    # callers pick the model explicitly, like DfinityTest does
+    latency = registry_network_latencies.get_by_name(latency_name)
+    city_index = getattr(latency, "city_index", None)
+    cols = build_node_columns(nodes, city_index)
+    proto = BatchedDfinity(params, roles, max_heights)
+    net = BatchedNetwork(proto, latency, n, capacity=capacity)
+    state = net.init_state(cols, seed=seed, proto=proto.proto_init(n))
+    return net, state

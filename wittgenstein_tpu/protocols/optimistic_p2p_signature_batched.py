@@ -1,0 +1,129 @@
+"""Batched OptimisticP2PSignature: every node's signature floods the P2P
+graph; a node finishes when it holds `threshold` distinct signatures.
+
+Reference semantics: protocols/OptimisticP2PSignature.java — SendSig
+(:86-103, 52 bytes), flood-on-first-sight with a done-stops-everything
+guard (:114-133), the t=1 self-sig task per node (:156-165), and the
+2*pairingTime verification delay on doneAt (:131).
+
+Design: the same frontier reduction as p2pflood_batched, with the sig
+bitset as a dense bool matrix `received[N, N]` (node × signature).  The
+oracle's int-as-bitset popcount becomes a row-sum; the "done" guard
+freezes a node's row (done nodes neither record nor forward new sigs —
+OptimisticP2PSignature.java:117)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.node import build_node_columns
+from ..core.registries import registry_network_latencies
+from ..engine import BatchedNetwork, BatchedProtocol, Emission
+from .optimistic_p2p_signature import (
+    OptimisticP2PSignature,
+    OptimisticP2PSignatureParameters,
+)
+from .p2pflood_batched import build_adjacency
+
+
+class BatchedOptimisticP2PSignature(BatchedProtocol):
+    MSG_TYPES = ["SEND_SIG"]
+    PAYLOAD_WIDTH = 1  # signature id (the signer's node id)
+    TICK_INTERVAL = None  # pure message protocol: engine may skip empty ms
+
+    def __init__(self, params: OptimisticP2PSignatureParameters, adjacency: np.ndarray):
+        self.params = params
+        self.adj = jnp.asarray(adjacency, jnp.int32)
+        self.n_nodes = params.node_count
+
+    def msg_size(self, mtype: int) -> int:
+        return 4 + 48  # NodeId + sig (OptimisticP2PSignature.java:92)
+
+    def proto_init(self, n_nodes: int):
+        # each node's own sig is recorded when its t=1 task runs on_sig on
+        # itself; baked in here, with the forward as the initial emission
+        return {"received": jnp.eye(n_nodes, dtype=bool)}
+
+    def _forward(self, state, src, sig, mask, exclude):
+        """src[K] forwards signature sig[K] to every peer except exclude[K]
+        at time+1 (the `network.time + 1` send in on_sig)."""
+        n_peers = self.adj.shape[1]
+        src_r = jnp.repeat(src, n_peers)
+        dest = self.adj[src].reshape(-1)
+        ok = jnp.repeat(mask, n_peers) & (dest >= 0) & (dest != jnp.repeat(exclude, n_peers))
+        return Emission(
+            mask=ok,
+            from_idx=src_r,
+            to_idx=jnp.maximum(dest, 0),
+            mtype=self.mtype("SEND_SIG"),
+            payload=jnp.repeat(sig, n_peers)[:, None],
+            send_time=jnp.broadcast_to(state.time + 1, ok.shape),
+        )
+
+    def initial_emissions(self, net, state):
+        """The per-node registered task fires at t=1 and sends at t=2
+        (OptimisticP2PSignature.java:156-165: `send(ss, time+1, ...)`)."""
+        ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
+        em = self._forward(
+            state._replace(time=jnp.int32(1)),
+            ids,
+            ids,
+            jnp.ones(self.n_nodes, bool),
+            jnp.full(self.n_nodes, -1, jnp.int32),
+        )
+        return [em]
+
+    def deliver(self, net, state, deliver_mask):
+        p = self.params
+        c = deliver_mask.shape[0]
+        to = state.msg_to
+        sig = state.msg_payload[:, 0]
+        received = state.proto["received"]
+        was_done = state.done_at > 0
+        fresh = deliver_mask & ~received[to, sig] & ~was_done[to]
+
+        slot = jnp.arange(c, dtype=jnp.int32)
+        winner = jnp.full((self.n_nodes, self.n_nodes), c, jnp.int32)
+        winner = winner.at[to, sig].min(jnp.where(fresh, slot, c), mode="drop")
+        is_winner = fresh & (winner[to, sig] == slot)
+
+        received = received.at[to, sig].max(fresh, mode="drop")
+        count = jnp.sum(received, axis=1).astype(jnp.int32)
+        done = (count >= p.threshold) & ~was_done & ~state.down
+        # doneAt = now + 2*pairingTime (OptimisticP2PSignature.java:131)
+        done_at = jnp.where(
+            done, state.time + 2 * p.pairing_time, state.done_at
+        )
+
+        em = self._forward(state, to, sig, is_winner, state.msg_from)
+        state = state._replace(proto={"received": received}, done_at=done_at)
+        return state, [em]
+
+    def all_done(self, state):
+        return jnp.all(jnp.where(~state.down, state.done_at > 0, True))
+
+
+def make_optimistic(
+    params: Optional[OptimisticP2PSignatureParameters] = None,
+    capacity: int = 1 << 15,
+    seed: int = 0,
+):
+    """Host-side construction: oracle init builds the P2P graph (same
+    JavaRandom stream → identical topology), baked into the engine."""
+    params = params or OptimisticP2PSignatureParameters()
+    oracle = OptimisticP2PSignature(params)
+    oracle.init()
+    net_o = oracle.network()
+    adj = build_adjacency(net_o)
+    latency = registry_network_latencies.get_by_name(params.network_latency_name)
+    city_index = getattr(latency, "city_index", None)
+    cols = build_node_columns(net_o.all_nodes, city_index)
+    proto = BatchedOptimisticP2PSignature(params, adj)
+    net = BatchedNetwork(proto, latency, params.node_count, capacity=capacity)
+    state = net.init_state(
+        cols, seed=seed, proto=proto.proto_init(params.node_count)
+    )
+    return net, state

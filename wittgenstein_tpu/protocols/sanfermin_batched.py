@@ -1,0 +1,408 @@
+"""Batched SanFerminSignature: binomial-tree pairwise aggregation as
+vectorized per-tick kernels.
+
+Reference semantics: protocols/SanFerminSignature.java — the swap
+request/reply state machine (:229-323), timeout re-picks (:329-369),
+goNextLevel descent (:379-419), pairingTime aggregation commit (:434-455) —
+via the oracle port `protocols/sanfermin.py`.
+
+TPU-first design:
+
+  * binary-id interval sets (SanFerminHelper.java:46-96) are XOR blocks:
+    with W = log2(N), the candidate set at prefix length `cpl` is
+    { me ^ (bs + r) : r in [0, bs) } with bs = 2^(W-cpl-1), and the "exact"
+    candidate (own-set index pick, SanFerminHelper.java:129-136) is r = 0
+    (partner = me ^ bs).  No interval arithmetic at runtime — just XOR.
+  * pickNextNodes' used-candidate tracking collapses to ONE cursor per
+    node (levels never revisit): position 0 is the exact candidate,
+    positions >= 1 enumerate the rest of the block through a per-(node,
+    level) XOR bijection — a uniform-random untried pick, standing in for
+    the reference's index-order-with-shuffle (and its post-removal index
+    shift quirk, SanFerminHelper.java:123-157), which is not worth
+    reproducing bit-for-bit.
+  * pending_nodes is a packed absolute-id bitset [N, N/32]; reset on level
+    entry, bit-tested on replies.
+  * one live timeout per node (re-armed on every send).  The oracle stacks
+    a timeout per send and fires ALL of them while the level is unchanged
+    (SanFerminSignature.java:356-366), so it can re-pick slightly faster
+    under repeated NO replies; documented approximation.
+  * same-tick transition races (multiple valid REQ/REP arrivals) resolve
+    by lowest ring slot; the losers' content is simply not aggregated —
+    the oracle's LIFO-in-ms processing picks an equally arbitrary winner
+    (every reply is still answered).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.node import build_node_columns
+from ..core.registries import registry_network_latencies
+from ..engine import BatchedNetwork, BatchedProtocol, Emission
+from ..engine.rng import hash32
+from ..utils.more_math import log2
+from .sanfermin import SanFerminSignature, SanFerminSignatureParameters
+
+INT32_MAX = jnp.int32(2**31 - 1)
+
+
+class BatchedSanFermin(BatchedProtocol):
+    MSG_TYPES = ["SWAP_REQ", "SWAP_REP_OK", "SWAP_REP_NO"]
+    PAYLOAD_WIDTH = 2  # (level, agg_value)
+    TICK_INTERVAL = 1  # timeouts + pairing commits need per-ms ticks
+
+    def __init__(self, params: SanFerminSignatureParameters):
+        self.params = params
+        self.n_nodes = params.node_count
+        self.w = log2(self.n_nodes)
+        assert 1 << self.w == self.n_nodes, "node_count must be a power of two"
+        self.n_words = max(1, self.n_nodes // 32)
+
+    def msg_size(self, mtype: int) -> int:
+        return 4 + self.params.signature_size  # uint32 + sig (both types)
+
+    def proto_init(self, n_nodes: int, seed: int = 0):
+        w = self.w
+        cache_val = jnp.zeros((n_nodes, w + 1), jnp.int32)
+        cache_ok = jnp.zeros((n_nodes, w + 1), bool)
+        # the t=1 goNextLevel is pre-applied: cpl = W-1, cache[W-1] = 1
+        cache_val = cache_val.at[:, w - 1].set(1)
+        cache_ok = cache_ok.at[:, w - 1].set(True)
+        # ... including its send bookkeeping (cursor/pending for the
+        # exact-candidate + candidate_count initial contacts); the matching
+        # emission rows are built by initial_emissions from the same seed
+        cc = max(1, self.params.candidate_count)
+        eng_seed = jnp.int32(np.int64(seed) & 0x7FFFFFFF)  # matches init_state
+        ids = jnp.arange(n_nodes, dtype=jnp.int32)
+        cpl0 = jnp.full(n_nodes, w - 1, jnp.int32)
+        pending = jnp.zeros((n_nodes, self.n_words), jnp.uint32)
+        for j in range(1 + cc):
+            partner, ok = self._partner(
+                eng_seed, ids, cpl0, jnp.full(n_nodes, j, jnp.int32)
+            )
+            pending = jnp.where(
+                ok[:, None], pending | self._onehot_words(partner), pending
+            )
+        return {
+            "cpl": jnp.full(n_nodes, w - 1, jnp.int32),
+            "agg": jnp.ones(n_nodes, jnp.int32),
+            "done": jnp.zeros(n_nodes, bool),
+            "thr_done": jnp.zeros(n_nodes, bool),
+            "thr_at": jnp.zeros(n_nodes, jnp.int32),
+            "swapping": jnp.zeros(n_nodes, bool),
+            "swap_add": jnp.zeros(n_nodes, jnp.int32),
+            "swap_t": jnp.zeros(n_nodes, jnp.int32),
+            "cache_val": cache_val,
+            "cache_ok": cache_ok,
+            "pending": pending,
+            "cursor": jnp.full(n_nodes, 1 + cc, jnp.int32),
+            "resend": jnp.zeros(n_nodes, bool),  # NO-reply re-pick flag
+            "tmo_t": jnp.full(n_nodes, 1 + self.params.reply_timeout, jnp.int32),
+            "tmo_lvl": jnp.full(n_nodes, w - 1, jnp.int32),
+            "sent_req": jnp.zeros(n_nodes, jnp.int32),
+            "recv_req": jnp.zeros(n_nodes, jnp.int32),
+        }
+
+    # -- candidate enumeration ----------------------------------------------
+    def _bs(self, cpl):
+        """Candidate-block size at prefix length cpl: 2^(W-cpl-1)."""
+        return (jnp.int32(1) << (self.w - 1 - cpl)).astype(jnp.int32)
+
+    def _partner(self, seed, ids, cpl, position):
+        """The `position`-th candidate of node `ids` at level `cpl`:
+        position 0 = exact candidate (r=0), then an XOR-bijection walk of
+        the rest of the block.  Returns (partner, valid)."""
+        bs = self._bs(cpl)
+        x = hash32(seed, ids, cpl, jnp.int32(0x5AFE)) & (bs - 1)
+        q = position - 1
+        p = q + (q >= x).astype(jnp.int32)  # skip the slot that maps to 0
+        r = jnp.where(position == 0, 0, p ^ x)
+        partner = ids ^ (bs + r)
+        return partner, position < bs
+
+    def _onehot_words(self, idx):
+        """Absolute-id onehot over the packed [n_words] axis."""
+        word = idx // 32
+        bit = (jnp.uint32(1) << (idx % 32).astype(jnp.uint32)).astype(jnp.uint32)
+        cols = jnp.arange(self.n_words, dtype=jnp.int32)
+        return jnp.where(
+            cols[None, :] == word[:, None], bit[:, None], jnp.uint32(0)
+        )
+
+    def _getbit(self, words, rows, idx):
+        """Bit `idx[K]` of the packed row `words[rows[K]]`."""
+        w = words[rows, idx // 32]
+        return (w >> (idx % 32).astype(jnp.uint32)) & jnp.uint32(1)
+
+    def _send_requests(self, state, mask, entering, proto):
+        """_send_to_nodes (SanFerminSignature.java:329-369): contact the
+        next candidates — exact-first on level entry, candidate_count per
+        re-pick — update pending/cursor, arm the timeout."""
+        cc = max(1, self.params.candidate_count)
+        k = 1 + cc
+        n = self.n_nodes
+        ids = jnp.arange(n, dtype=jnp.int32)
+        cpl, cursor, agg = proto["cpl"], proto["cursor"], proto["agg"]
+        npick = jnp.where(entering, 1 + cc, cc)
+
+        rows_mask, rows_from, rows_to = [], [], []
+        pending = proto["pending"]
+        for j in range(k):
+            pos = cursor + j
+            partner, in_block = self._partner(state.seed, ids, cpl, pos)
+            m = mask & (j < npick) & in_block
+            rows_mask.append(m)
+            rows_from.append(ids)
+            rows_to.append(partner)
+            pending = jnp.where(
+                m[:, None], pending | self._onehot_words(partner), pending
+            )
+        mask_k = jnp.stack(rows_mask, 1).reshape(-1)
+        from_k = jnp.stack(rows_from, 1).reshape(-1)
+        to_k = jnp.stack(rows_to, 1).reshape(-1)
+        em = Emission(
+            mask=mask_k,
+            from_idx=from_k,
+            to_idx=jnp.clip(to_k, 0, n - 1),
+            mtype=self.mtype("SWAP_REQ"),
+            payload=jnp.stack(
+                [
+                    jnp.repeat(cpl[:, None], k, 1).reshape(-1),
+                    jnp.repeat(agg[:, None], k, 1).reshape(-1),
+                ],
+                axis=1,
+            ),
+        )
+        proto = dict(
+            proto,
+            pending=pending,
+            cursor=jnp.where(mask, cursor + npick, cursor),
+            sent_req=proto["sent_req"]
+            + jnp.sum(
+                jnp.stack(rows_mask, 1).astype(jnp.int32), axis=1
+            ),
+            # re-arm the reply timeout (one live timeout per node)
+            tmo_t=jnp.where(mask, state.time + 1 + self.params.reply_timeout, proto["tmo_t"]),
+            tmo_lvl=jnp.where(mask, cpl, proto["tmo_lvl"]),
+        )
+        return proto, em
+
+    # -- message handling ----------------------------------------------------
+    def deliver(self, net, state, deliver_mask):
+        p = self.params
+        proto = dict(state.proto)
+        n = self.n_nodes
+        c = deliver_mask.shape[0]
+        t = state.time
+        ids = jnp.arange(n, dtype=jnp.int32)
+        to, frm = state.msg_to, state.msg_from
+        lvl_p = jnp.clip(state.msg_payload[:, 0], 0, self.w)
+        val_p = state.msg_payload[:, 1]
+        slot = jnp.arange(c, dtype=jnp.int32)
+
+        is_req = deliver_mask & (state.msg_type == self.mtype("SWAP_REQ"))
+        is_ok = deliver_mask & (state.msg_type == self.mtype("SWAP_REP_OK"))
+        is_no = deliver_mask & (state.msg_type == self.mtype("SWAP_REP_NO"))
+
+        cpl, done, swapping = proto["cpl"], proto["done"], proto["swapping"]
+        cache_ok, cache_val = proto["cache_ok"], proto["cache_val"]
+        # sender in receiver's candidate set at level L:
+        # (me ^ from) in [bs(L), 2*bs(L))  (SanFerminHelper.java:46-96)
+        xorv = to ^ frm
+        bs_p = (jnp.int32(1) << jnp.clip(self.w - 1 - lvl_p, 0, self.w)).astype(jnp.int32)
+        is_cand_at_lvl = (xorv >= bs_p) & (xorv < 2 * bs_p)
+
+        proto["recv_req"] = proto["recv_req"] + jnp.zeros(n, jnp.int32).at[to].add(
+            is_req.astype(jnp.int32), mode="drop"
+        )
+
+        # ---- on_swap_request (:229-270) -----------------------------------
+        lvl_mismatch = done[to] | (lvl_p != cpl[to])
+        cached = cache_ok[to, lvl_p]
+        # case A1: stale/done receiver with a cached value -> OK(cached)
+        a1 = is_req & lvl_mismatch & cached
+        # case A2: stale/done receiver, no cache -> NO(0) at receiver's cpl,
+        # remembering the offered value when the sender is a candidate
+        a2 = is_req & lvl_mismatch & ~cached
+        # case B: level match while swapping -> optimistic OK(agg)
+        b = is_req & ~lvl_mismatch & swapping[to]
+        # case C: level match, idle -> valid swap request (transition)
+        c_req = is_req & ~lvl_mismatch & ~swapping[to] & is_cand_at_lvl
+
+        # replies: cases A1/A2/B only — a valid swap REQUEST (case C) is
+        # absorbed into the receiver's transition and NEVER answered; the
+        # requester is rescued by its reply timeout (the reference's
+        # requester-loses asymmetry, SanFerminSignature.java:251-262)
+        rep_ok = a1 | b
+        rep_val = jnp.where(a1, cache_val[to, lvl_p], proto["agg"][to])
+        rep_lvl = jnp.where(a2, cpl[to], lvl_p)
+        reply_em = Emission(
+            mask=a1 | a2 | b,
+            from_idx=to,
+            to_idx=frm,
+            mtype=jnp.where(
+                rep_ok, self.mtype("SWAP_REP_OK"), self.mtype("SWAP_REP_NO")
+            ),
+            payload=jnp.stack([rep_lvl, jnp.where(rep_ok, rep_val, 0)], axis=1),
+        )
+
+        # A2 cache store (winner = lowest slot per (node, level))
+        store = a2 & is_cand_at_lvl
+        winner = jnp.full((n, self.w + 1), c, jnp.int32)
+        winner = winner.at[to, lvl_p].min(jnp.where(store, slot, c), mode="drop")
+        is_wstore = store & (winner[to, lvl_p] == slot)
+        # scatter ONLY the winner rows (losers routed out of bounds):
+        # writing `where(win, new, current)` for every row would race —
+        # XLA's duplicate-index .set order is unspecified, so a stale row's
+        # "current" write can clobber the winner's value
+        w_to = jnp.where(is_wstore, to, n)
+        cache_val = cache_val.at[w_to, lvl_p].set(val_p, mode="drop")
+        cache_ok = cache_ok.at[w_to, lvl_p].set(True, mode="drop")
+        proto["cache_val"], proto["cache_ok"] = cache_val, cache_ok
+
+        # ---- on_swap_reply (:272-323) -------------------------------------
+        live = ~done[to] & (lvl_p == cpl[to]) & ~swapping[to]
+        in_pending = self._getbit(proto["pending"], to, frm) == 1
+        ok_trigger = is_ok & live & (in_pending | is_cand_at_lvl)
+        no_trigger = is_no & live & in_pending
+
+        # ---- transitions: winner per node among C + OK triggers -----------
+        trig = c_req | ok_trigger
+        twin = jnp.full(n, c, jnp.int32)
+        twin = twin.at[to].min(jnp.where(trig, slot, c), mode="drop")
+        has_t = twin < c
+        tslot = jnp.clip(twin, 0, c - 1)
+        add_val = val_p[tslot]
+        proto["swapping"] = swapping | has_t
+        proto["swap_add"] = jnp.where(has_t, add_val, proto["swap_add"])
+        proto["swap_t"] = jnp.where(has_t, t + p.pairing_time, proto["swap_t"])
+
+        # NO replies from pending partners re-pick next candidates in the
+        # tick phase (flag survives until consumed)
+        got_no = jnp.zeros(n, bool).at[to].max(no_trigger, mode="drop")
+        proto["resend"] = proto["resend"] | got_no
+
+        return state._replace(proto=proto), [reply_em]
+
+    # -- per-tick: commits, level descent, timeouts, sends -------------------
+    def tick(self, net, state):
+        p = self.params
+        proto = dict(state.proto)
+        t = state.time
+        n = self.n_nodes
+        w = self.w
+
+        # 1. aggregation commit at swap_t (do_aggregate + goNextLevel,
+        # :434-455, :379-419)
+        commit = proto["swapping"] & (t >= proto["swap_t"]) & (proto["swap_t"] > 0)
+        agg = jnp.where(commit, proto["agg"] + proto["swap_add"], proto["agg"])
+
+        thr_now = commit & ~proto["thr_done"] & (agg >= p.threshold)
+        proto["thr_done"] = proto["thr_done"] | thr_now
+        proto["thr_at"] = jnp.where(thr_now, t + 2 * p.pairing_time, proto["thr_at"])
+
+        finish = commit & (proto["cpl"] == 0)
+        descend = commit & ~finish
+        proto["done"] = proto["done"] | finish
+        state = state._replace(
+            done_at=jnp.where(finish, t + 2 * p.pairing_time, state.done_at)
+        )
+
+        new_cpl = jnp.where(descend, proto["cpl"] - 1, proto["cpl"])
+        proto["cache_val"] = jnp.where(
+            descend[:, None]
+            & (jnp.arange(w + 1)[None, :] == new_cpl[:, None]),
+            agg[:, None],
+            proto["cache_val"],
+        )
+        proto["cache_ok"] = proto["cache_ok"] | (
+            descend[:, None] & (jnp.arange(w + 1)[None, :] == new_cpl[:, None])
+        )
+        proto["agg"] = agg
+        proto["cpl"] = new_cpl
+        proto["swapping"] = proto["swapping"] & ~commit
+        proto["pending"] = jnp.where(
+            descend[:, None], jnp.uint32(0), proto["pending"]
+        )
+        proto["cursor"] = jnp.where(descend, 0, proto["cursor"])
+        proto["resend"] = proto["resend"] & ~commit
+
+        # 2. reply timeout (fires while the level is unchanged, :356-366)
+        tmo = (
+            ~proto["done"]
+            & (proto["tmo_t"] > 0)
+            & (t >= proto["tmo_t"])
+            & (proto["tmo_lvl"] == proto["cpl"])
+        )
+        # disarm on fire (or when the level moved on); _send_requests
+        # re-arms for the nodes that actually send
+        stale = (proto["tmo_t"] > 0) & (t >= proto["tmo_t"])
+        proto["tmo_t"] = jnp.where(stale, 0, proto["tmo_t"])
+
+        # 3. sends: level entry (exact-first) or re-pick (timeout / NO)
+        send = (descend | tmo | proto["resend"]) & ~proto["done"]
+        send = send & (proto["cursor"] < self._bs(proto["cpl"]))
+        proto["resend"] = proto["resend"] & ~send
+        proto, em = self._send_requests(state, send, descend, proto)
+        state = state._replace(proto=proto)
+        return net.apply_emission(state, em)
+
+    def initial_emissions(self, net, state):
+        """The pre-applied t=1 goNextLevel's sends: every node contacts its
+        exact candidate (+ candidate_count more).  The matching cursor /
+        pending / timeout bookkeeping is already baked into proto_init
+        (same seed, same _partner walk), so this only builds the rows."""
+        cc = max(1, self.params.candidate_count)
+        k = 1 + cc
+        n = self.n_nodes
+        ids = jnp.arange(n, dtype=jnp.int32)
+        cpl = state.proto["cpl"]
+        rows_mask, rows_to = [], []
+        for j in range(k):
+            partner, in_block = self._partner(
+                state.seed, ids, cpl, jnp.full(n, j, jnp.int32)
+            )
+            rows_mask.append(in_block)
+            rows_to.append(partner)
+        return [
+            Emission(
+                mask=jnp.stack(rows_mask, 1).reshape(-1),
+                from_idx=jnp.repeat(ids, k),
+                to_idx=jnp.clip(jnp.stack(rows_to, 1).reshape(-1), 0, n - 1),
+                mtype=self.mtype("SWAP_REQ"),
+                payload=jnp.stack(
+                    [
+                        jnp.repeat(cpl[:, None], k, 1).reshape(-1),
+                        jnp.repeat(state.proto["agg"][:, None], k, 1).reshape(-1),
+                    ],
+                    axis=1,
+                ),
+            )
+        ]
+
+    def all_done(self, state):
+        return jnp.all(state.proto["done"])
+
+
+def make_sanfermin(
+    params: Optional[SanFerminSignatureParameters] = None,
+    capacity: int = 1 << 14,
+    seed: int = 0,
+):
+    """Host-side construction: the oracle builds the node population (same
+    JavaRandom stream → same layout), baked into the engine."""
+    params = params or SanFerminSignatureParameters()
+    oracle = SanFerminSignature(params)
+    net_o = oracle.network()
+    latency = registry_network_latencies.get_by_name(params.network_latency_name)
+    city_index = getattr(latency, "city_index", None)
+    cols = build_node_columns(net_o.all_nodes, city_index)
+    proto = BatchedSanFermin(params)
+    net = BatchedNetwork(proto, latency, params.node_count, capacity=capacity)
+    state = net.init_state(
+        cols, seed=seed, proto=proto.proto_init(params.node_count, seed=seed)
+    )
+    return net, state
