@@ -178,6 +178,28 @@ def bench_batched(node_ct: int, n_replicas: int) -> dict:
     }
 
 
+def _run_rung(node_ct: int, n_replicas: int, timeout_s: int) -> dict:
+    """Run one ladder rung in a KILLABLE subprocess: a wedged TPU worker
+    makes compiles/executions hang forever (not raise), and a hang must
+    cost one rung's timeout, not the whole bench."""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--rung", str(node_ct), str(n_replicas)],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"{node_ct}x{n_replicas}: rung timed out after {timeout_s}s (wedged TPU worker?)"}
+    if r.returncode != 0:
+        return {"error": f"{node_ct}x{n_replicas}: rc={r.returncode}: {r.stderr.strip()[-300:]}"}
+    try:
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception:
+        return {"error": f"{node_ct}x{n_replicas}: unparseable rung output: {r.stdout[-200:]}"}
+
+
 def main() -> None:
     probe = _probe_backend()
 
@@ -192,19 +214,29 @@ def main() -> None:
     device_kind = getattr(devs[0], "device_kind", "?")
 
     if platform == "tpu":
-        ladder = [(4096, 32), (4096, 16), (4096, 8), (1024, 16)]
+        # 4096 first (the north-star size; its compile can wedge the
+        # worker, hence the subprocess watchdogs), then known-good rungs
+        ladder = [(4096, 32, 1500), (4096, 8, 900), (2048, 16, 900), (1024, 16, 700)]
     else:
-        ladder = [(256, 4)]
+        ladder = [(256, 4, 900)]
     if os.environ.get("WITT_BENCH_REPLICAS"):
-        ladder = [(ladder[0][0], int(os.environ["WITT_BENCH_REPLICAS"]))]
+        ladder = [(ladder[0][0], int(os.environ["WITT_BENCH_REPLICAS"]), ladder[0][2])]
 
-    result, bench_error = None, None
-    for node_ct, n_replicas in ladder:
-        try:
-            result = bench_batched(node_ct, n_replicas)
+    result, errors = None, []
+    for node_ct, n_replicas, rung_timeout in ladder:
+        if platform != "tpu":
+            try:
+                result = bench_batched(node_ct, n_replicas)
+            except Exception as e:
+                errors.append(f"{node_ct}x{n_replicas}: {type(e).__name__}: {str(e)[:300]}")
+                result = None
             break
-        except Exception as e:  # OOM etc: step down the ladder, keep the trace
-            bench_error = f"{node_ct}x{n_replicas}: {type(e).__name__}: {str(e)[:300]}"
+        r = _run_rung(node_ct, n_replicas, rung_timeout)
+        if "error" not in r:
+            result = r
+            break
+        errors.append(r["error"])
+    bench_error = "; ".join(errors) if errors else None
     if result is None:
         print(
             json.dumps(
@@ -249,4 +281,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) == 4 and sys.argv[1] == "--rung":
+        # child mode: one ladder rung, JSON on stdout (no probe — the
+        # parent already established the platform)
+        print(json.dumps(bench_batched(int(sys.argv[2]), int(sys.argv[3]))))
+    else:
+        main()

@@ -1,0 +1,84 @@
+"""Batched CasperIMD: chain-shape parity with the oracle, fork choice,
+attestation accounting, determinism.
+
+With the default parameters the honest run builds a linear chain — one
+block per slot, each on its direct parent — and the traffic is
+deterministic in aggregate, so the oracle comparison can be exact on
+message counts and chain structure."""
+
+import numpy as np
+
+from wittgenstein_tpu.engine import replicate_state
+from wittgenstein_tpu.oracle.blockchain import Block
+from wittgenstein_tpu.protocols.casper import CasperIMD, CasperParameters
+from wittgenstein_tpu.protocols.casper_batched import make_casper
+
+RUN_MS = 80000  # 10 slots
+
+
+def oracle_run(params, run_ms=RUN_MS, seed=0):
+    Block.reset_block_ids()
+    o = CasperIMD(params)
+    o.network().rd.set_seed(seed)
+    o.init()
+    o.network().run_ms(run_ms)
+    heights = np.array([n.head.height for n in o.network().all_nodes])
+    msgs = sum(n.msg_received for n in o.network().all_nodes)
+    return o, heights, msgs
+
+
+class TestBatchedCasper:
+    def test_oracle_parity_linear_chain(self):
+        """Default honest run: same per-height linear chain, the same
+        total message count, heads within one slot of the oracle."""
+        p = CasperParameters()
+        _, oh, om = oracle_run(p)
+        net, state = make_casper(p, max_heights=16)
+        out = net.run_ms(state, RUN_MS)
+        bh = np.asarray(out.proto["head"])
+        parent = np.asarray(out.proto["blk_parent"])
+        exists = np.asarray(out.proto["blk_exists"])
+        n_blocks = int(exists.sum()) - 1  # minus genesis
+        assert n_blocks >= 9
+        # linear chain: block h sits on h-1
+        for h in range(1, n_blocks + 1):
+            assert parent[h] == h - 1
+        assert abs(int(bh.max()) - int(oh.max())) <= 1
+        bm = int(np.asarray(out.msg_received).sum())
+        assert bm == om, (om, bm)
+        assert int(out.dropped) == 0
+
+    def test_attestations_complete(self):
+        """Every slot's committee (attesters_per_round members) attests
+        exactly once; blocks include the prior committee's attestations."""
+        p = CasperParameters()
+        net, state = make_casper(p, max_heights=16)
+        out = net.run_ms(state, RUN_MS)
+        att = np.asarray(out.proto["att_exists"])
+        apr = p.attesters_per_round
+        votes_per_height = att.reshape(-1, apr).sum(axis=1)
+        full_heights = votes_per_height[votes_per_height > 0]
+        assert (full_heights == apr).all()
+        # each block (from height 2 on) carries its parent-height votes
+        blk_att = np.asarray(out.proto["blk_att"])
+        exists = np.asarray(out.proto["blk_exists"])
+        for h in range(2, int(exists.sum()) - 1):
+            assert blk_att[h].sum() >= apr, h
+
+    def test_heads_advance_with_slots(self):
+        net, state = make_casper(CasperParameters(), max_heights=16)
+        s1 = net.run_ms(state, 40000)
+        h1 = int(np.asarray(s1.proto["head"]).max())
+        s2 = net.run_ms(s1, 40000)
+        h2 = int(np.asarray(s2.proto["head"]).max())
+        assert h1 >= 3
+        assert h2 > h1
+
+    def test_replicas_and_determinism(self):
+        net, state = make_casper(CasperParameters(), max_heights=16)
+        states = replicate_state(state, 4, seeds=[1, 2, 3, 4])
+        a = net.run_ms_batched(states, 40000)
+        ha = np.asarray(a.proto["head"])
+        assert (ha.max(axis=1) >= 3).all()
+        b = net.run_ms_batched(states, 40000)
+        assert (np.asarray(b.proto["head"]) == ha).all()

@@ -1,0 +1,378 @@
+"""Batched classic Paxos: acceptors and proposers as vectorized per-slot
+kernels; proposer timeouts as explicit-arrival self-messages.
+
+Reference semantics: protocols/Paxos.java (AcceptorNode :153-207,
+ProposerNode :209-339, seq scheme :313-338) via the oracle port
+`protocols/paxos.py`.
+
+TPU-first notes:
+
+  * every per-node field is a scalar column; Optional[int] becomes -1;
+  * `registerTask(onTimeout, ...)` becomes a size-0 TIMEOUT self-message
+    with an explicit arrival (the engine's sendArriveAt path), so the
+    protocol stays pure-message (TICK_INTERVAL None — the engine skips
+    idle ms);
+  * in-progress counters are capped at `majority`, so a crossing fires
+    exactly once (the oracle's `count < majority` entry guard);
+  * same-tick batches of PROPOSE/COMMIT at one acceptor are all evaluated
+    against the pre-tick acceptor state (the oracle orders them LIFO
+    within the ms); the acceptor state then advances with the max-seq
+    winner.  AGREE bookkeeping takes the same-tick max of (acceptedSeq,
+    acceptedVal) pairs via a packed scatter-max.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.node import build_node_columns
+from ..core.registries import registry_network_latencies
+from ..engine import BatchedNetwork, BatchedProtocol, Emission
+from .paxos import MAX_VAL, Paxos, PaxosParameters
+
+NONE = jnp.int32(-1)
+# packed (acceptedSeq, acceptedVal) scatter-max key; val < MAX_VAL=1000 < 2048
+VAL_PACK = 2048
+
+
+class BatchedPaxos(BatchedProtocol):
+    MSG_TYPES = ["PROPOSE", "REJECT", "AGREE", "COMMIT", "ACCEPT", "REJECT2", "TIMEOUT"]
+    PAYLOAD_WIDTH = 3  # AGREE carries (yourSeq, acceptedSeq, acceptedVal)
+    TICK_INTERVAL = None
+
+    def __init__(self, params: PaxosParameters, roles: dict):
+        self.params = params
+        self.majority = params.acceptor_count // 2 + 1
+        self.n_acc = params.acceptor_count
+        self.n_prop = params.proposer_count
+        self.n_nodes = self.n_acc + self.n_prop
+        self.is_acc = jnp.asarray(roles["is_acc"])
+        self.is_prop = jnp.asarray(roles["is_prop"])
+        self.rank = jnp.asarray(roles["rank"], jnp.int32)
+        self.value_proposed = jnp.asarray(roles["value_proposed"], jnp.int32)
+        self.acc_ids = jnp.asarray(roles["acc_ids"], jnp.int32)
+        self.prop_ids = jnp.asarray(roles["prop_ids"], jnp.int32)
+
+    def msg_size(self, mtype: int) -> int:
+        return 0 if self.MSG_TYPES[mtype] == "TIMEOUT" else 1
+
+    def proto_init(self, n_nodes: int):
+        zi = lambda: jnp.zeros(n_nodes, jnp.int32)
+        none = lambda: jnp.full(n_nodes, NONE, jnp.int32)
+        # the init-time startNextProposal is pre-applied: first seq is
+        # proposerCount + rank (seqAccepted=0, seqIP=0 path, :329-333);
+        # initial_emissions builds the matching PROPOSE + TIMEOUT rows
+        first_seq = jnp.where(
+            self.is_prop, self.params.proposer_count + self.rank, 0
+        ).astype(jnp.int32)
+        return {
+            # acceptor columns (Paxos.java:153-160)
+            "max_agreed": none(),
+            "acc_seq": none(),
+            "acc_val": none(),
+            # proposer columns (:209-240)
+            "seq_ip": first_seq,
+            "prop_ip": self.is_prop,
+            "seq_accepted": zi(),
+            "asi": none(),  # acceptedSeqIP
+            "avi": none(),  # acceptedValIP
+            "agree_ip": zi(),
+            "rej1_ip": zi(),
+            "accept_ip": zi(),
+            "rej2_ip": zi(),
+            "value_accepted": none(),
+            "agree_count": zi(),
+            "rej1_count": zi(),
+            "rej2_count": zi(),
+            "timeout_count": zi(),
+        }
+
+    # -- proposer round start (startNextProposal, :313-338) ------------------
+    def _start_proposals(self, state, mask, proto):
+        """Reset in-progress state, pick the next seq, PROPOSE to every
+        acceptor and arm the timeout self-message."""
+        p = self.params
+        n = self.n_nodes
+        ids = jnp.arange(n, dtype=jnp.int32)
+        pc = p.proposer_count
+        gap = proto["seq_accepted"] % pc
+        cand = proto["seq_accepted"] + pc - gap + self.rank
+        new_seq = jnp.where(cand > proto["seq_ip"], cand, proto["seq_ip"] + pc)
+        seq_ip = jnp.where(mask, new_seq, proto["seq_ip"])
+        proto = dict(
+            proto,
+            seq_ip=seq_ip,
+            prop_ip=jnp.where(mask, True, proto["prop_ip"]),
+            asi=jnp.where(mask, NONE, proto["asi"]),
+            avi=jnp.where(mask, NONE, proto["avi"]),
+            agree_ip=jnp.where(mask, 0, proto["agree_ip"]),
+            rej1_ip=jnp.where(mask, 0, proto["rej1_ip"]),
+            accept_ip=jnp.where(mask, 0, proto["accept_ip"]),
+            rej2_ip=jnp.where(mask, 0, proto["rej2_ip"]),
+        )
+        ka = self.n_prop * self.n_acc
+        em_prop = Emission(
+            mask=jnp.repeat(mask[self.prop_ids], self.n_acc),
+            from_idx=jnp.repeat(self.prop_ids, self.n_acc),
+            to_idx=jnp.tile(self.acc_ids, self.n_prop),
+            mtype=self.mtype("PROPOSE"),
+            payload=jnp.stack(
+                [
+                    jnp.repeat(seq_ip[self.prop_ids], self.n_acc),
+                    jnp.zeros(ka, jnp.int32),
+                    jnp.zeros(ka, jnp.int32),
+                ],
+                axis=1,
+            ),
+        )
+        # timeout: self-message at sent_time(+1) + timeout (:337-338)
+        em_tmo = Emission(
+            mask=mask[self.prop_ids],
+            from_idx=self.prop_ids,
+            to_idx=self.prop_ids,
+            mtype=self.mtype("TIMEOUT"),
+            payload=jnp.stack(
+                [
+                    seq_ip[self.prop_ids],
+                    jnp.zeros(self.n_prop, jnp.int32),
+                    jnp.zeros(self.n_prop, jnp.int32),
+                ],
+                axis=1,
+            ),
+            arrival=jnp.broadcast_to(
+                state.time + 1 + p.timeout, (self.n_prop,)
+            ).astype(jnp.int32),
+        )
+        return proto, [em_prop, em_tmo]
+
+    def initial_emissions(self, net, state):
+        """init: every proposer's first PROPOSE (sent at t=1) and its
+        timeout — the state side is pre-baked in proto_init."""
+        seq_ip = state.proto["seq_ip"]
+        ka = self.n_prop * self.n_acc
+        em_prop = Emission(
+            mask=jnp.ones(ka, bool),
+            from_idx=jnp.repeat(self.prop_ids, self.n_acc),
+            to_idx=jnp.tile(self.acc_ids, self.n_prop),
+            mtype=self.mtype("PROPOSE"),
+            payload=jnp.stack(
+                [
+                    jnp.repeat(seq_ip[self.prop_ids], self.n_acc),
+                    jnp.zeros(ka, jnp.int32),
+                    jnp.zeros(ka, jnp.int32),
+                ],
+                axis=1,
+            ),
+        )
+        em_tmo = Emission(
+            mask=jnp.ones(self.n_prop, bool),
+            from_idx=self.prop_ids,
+            to_idx=self.prop_ids,
+            mtype=self.mtype("TIMEOUT"),
+            payload=jnp.stack(
+                [
+                    seq_ip[self.prop_ids],
+                    jnp.zeros(self.n_prop, jnp.int32),
+                    jnp.zeros(self.n_prop, jnp.int32),
+                ],
+                axis=1,
+            ),
+            arrival=jnp.broadcast_to(
+                state.time + 1 + self.params.timeout, (self.n_prop,)
+            ).astype(jnp.int32),
+        )
+        return [em_prop, em_tmo]
+
+    def deliver(self, net, state, deliver_mask):
+        p = self.params
+        proto = dict(state.proto)
+        n, c = self.n_nodes, deliver_mask.shape[0]
+        t = state.time
+        ids = jnp.arange(n, dtype=jnp.int32)
+        to, frm = state.msg_to, state.msg_from
+        seq_p = state.msg_payload[:, 0]
+        p1 = state.msg_payload[:, 1]
+        p2 = state.msg_payload[:, 2]
+        m_ = lambda name: deliver_mask & (state.msg_type == self.mtype(name))
+        is_pro, is_rej, is_agr = m_("PROPOSE"), m_("REJECT"), m_("AGREE")
+        is_com, is_acc, is_rj2 = m_("COMMIT"), m_("ACCEPT"), m_("REJECT2")
+        is_tmo = m_("TIMEOUT")
+        emissions = []
+
+        # ---- acceptors: onPropose (:163-177) ------------------------------
+        ma = proto["max_agreed"]
+        agree = is_pro & (seq_p > ma[to])
+        reject = is_pro & (seq_p < ma[to])
+        emissions.append(
+            Emission(  # per-slot replies against pre-tick acceptor state
+                mask=agree | reject,
+                from_idx=to,
+                to_idx=frm,
+                mtype=jnp.where(agree, self.mtype("AGREE"), self.mtype("REJECT")),
+                payload=jnp.stack(
+                    [
+                        seq_p,
+                        jnp.where(agree, proto["acc_seq"][to], ma[to]),
+                        jnp.where(agree, proto["acc_val"][to], 0),
+                    ],
+                    axis=1,
+                ),
+            )
+        )
+        proto["max_agreed"] = ma.at[to].max(
+            jnp.where(agree, seq_p, NONE), mode="drop"
+        )
+
+        # ---- acceptors: onCommit (:179-192) -------------------------------
+        ok_com = is_com & (seq_p == ma[to]) & (
+            (proto["acc_val"][to] == NONE) | (proto["acc_val"][to] == p1)
+        )
+        rj_com = is_com & ~ok_com
+        emissions.append(
+            Emission(
+                mask=ok_com | rj_com,
+                from_idx=to,
+                to_idx=frm,
+                mtype=jnp.where(ok_com, self.mtype("ACCEPT"), self.mtype("REJECT2")),
+                payload=jnp.stack([seq_p, jnp.where(ok_com, 0, ma[to]), jnp.zeros(c, jnp.int32)], axis=1),
+            )
+        )
+        proto["acc_val"] = proto["acc_val"].at[to].max(
+            jnp.where(ok_com, p1, NONE), mode="drop"
+        )
+        proto["acc_seq"] = proto["acc_seq"].at[to].max(
+            jnp.where(ok_com, seq_p, NONE), mode="drop"
+        )
+
+        # ---- proposers: count replies for the current seq -----------------
+        live = proto["prop_ip"][to] & (seq_p == proto["seq_ip"][to])
+
+        def count(mask_slots, col, cap=True):
+            arr = jnp.zeros(n, jnp.int32).at[to].add(
+                (mask_slots & live).astype(jnp.int32), mode="drop"
+            )
+            new = proto[col] + arr
+            return jnp.minimum(new, self.majority) if cap else new
+
+        old_agree, old_rej1 = proto["agree_ip"], proto["rej1_ip"]
+        old_accept, old_rej2 = proto["accept_ip"], proto["rej2_ip"]
+        proto["agree_ip"] = count(is_agr, "agree_ip")
+        proto["rej1_ip"] = count(is_rej, "rej1_ip")
+        proto["accept_ip"] = count(is_acc, "accept_ip")
+        proto["rej2_ip"] = count(is_rj2, "rej2_ip")
+
+        # AGREE (acceptedSeq, acceptedVal) bookkeeping: same-tick max (:255-259)
+        has_prev = is_agr & live & (p1 != NONE)
+        pack = jnp.full(n, -1, jnp.int32).at[to].max(
+            jnp.where(has_prev, p1 * VAL_PACK + jnp.clip(p2, 0, VAL_PACK - 1), -1),
+            mode="drop",
+        )
+        better = (pack >= 0) & ((proto["asi"] == NONE) | (pack // VAL_PACK > proto["asi"]))
+        proto["asi"] = jnp.where(better, pack // VAL_PACK, proto["asi"])
+        proto["avi"] = jnp.where(better, pack % VAL_PACK, proto["avi"])
+
+        # rejection seq feedback: seqAccepted = max(seqAccepted, serverSeq)
+        rej_seq = jnp.zeros(n, jnp.int32).at[to].max(
+            jnp.where((is_rej | is_rj2) & live, p1, 0), mode="drop"
+        )
+
+        maj = self.majority
+        cross = lambda old, new: (old < maj) & (new >= maj)
+        agree_x = cross(old_agree, proto["agree_ip"])
+        rej1_x = cross(old_rej1, proto["rej1_ip"])
+        accept_x = cross(old_accept, proto["accept_ip"])
+        rej2_x = cross(old_rej2, proto["rej2_ip"])
+
+        # onAgree majority: commit the learned or own value (:260-268)
+        proto["agree_count"] = proto["agree_count"] + agree_x.astype(jnp.int32)
+        avi = jnp.where(
+            agree_x & (proto["avi"] == NONE), self.value_proposed, proto["avi"]
+        )
+        proto["avi"] = avi
+        emissions.append(
+            Emission(
+                mask=jnp.repeat(agree_x[self.prop_ids], self.n_acc),
+                from_idx=jnp.repeat(self.prop_ids, self.n_acc),
+                to_idx=jnp.tile(self.acc_ids, self.n_prop),
+                mtype=self.mtype("COMMIT"),
+                payload=jnp.stack(
+                    [
+                        jnp.repeat(proto["seq_ip"][self.prop_ids], self.n_acc),
+                        jnp.repeat(avi[self.prop_ids], self.n_acc),
+                        jnp.zeros(self.n_prop * self.n_acc, jnp.int32),
+                    ],
+                    axis=1,
+                ),
+            )
+        )
+
+        # onAccept majority: value accepted, node done (:269-280)
+        newly_done = accept_x & (proto["value_accepted"] == NONE)
+        proto["value_accepted"] = jnp.where(newly_done, avi, proto["value_accepted"])
+        proto["prop_ip"] = proto["prop_ip"] & ~(accept_x | rej1_x | rej2_x)
+        state = state._replace(
+            done_at=jnp.where(newly_done, jnp.maximum(t, 1), state.done_at)
+        )
+
+        # timeout while still in progress (:305-310)
+        tmo_fire = jnp.zeros(n, bool).at[to].max(is_tmo & live, mode="drop")
+        tmo_fire = tmo_fire & proto["prop_ip"] & ~(agree_x | accept_x)
+        proto["timeout_count"] = proto["timeout_count"] + tmo_fire.astype(jnp.int32)
+
+        # rejected or timed out -> next round (:244-249, :281-288)
+        proto["rej1_count"] = proto["rej1_count"] + rej1_x.astype(jnp.int32)
+        proto["rej2_count"] = proto["rej2_count"] + rej2_x.astype(jnp.int32)
+        proto["seq_accepted"] = jnp.where(
+            rej1_x | rej2_x,
+            jnp.maximum(proto["seq_accepted"], rej_seq),
+            proto["seq_accepted"],
+        )
+        restart = (rej1_x | rej2_x | tmo_fire) & (proto["value_accepted"] == NONE)
+        proto["prop_ip"] = proto["prop_ip"] & ~restart
+        proto, ems2 = self._start_proposals(state, restart, proto)
+        emissions += ems2
+
+        return state._replace(proto=proto), emissions
+
+    def all_done(self, state):
+        return jnp.all(
+            jnp.where(self.is_prop, state.proto["value_accepted"] != NONE, True)
+        )
+
+
+def make_paxos(
+    params: Optional[PaxosParameters] = None, capacity: int = 1 << 11, seed: int = 0
+):
+    """Host-side construction from the oracle's node population (same
+    JavaRandom stream: positions AND each proposer's valueProposed)."""
+    params = params or PaxosParameters()
+    oracle = Paxos(params)
+    oracle.init()
+    nodes = oracle.network().all_nodes
+    n = len(nodes)
+    from .paxos import AcceptorNode, ProposerNode
+
+    roles = {
+        "is_acc": np.array([isinstance(nd, AcceptorNode) for nd in nodes]),
+        "is_prop": np.array([isinstance(nd, ProposerNode) for nd in nodes]),
+        "rank": np.array([getattr(nd, "rank", 0) for nd in nodes], dtype=np.int32),
+        "value_proposed": np.array(
+            [getattr(nd, "value_proposed", 0) for nd in nodes], dtype=np.int32
+        ),
+        "acc_ids": np.array(
+            [nd.node_id for nd in nodes if isinstance(nd, AcceptorNode)], np.int32
+        ),
+        "prop_ids": np.array(
+            [nd.node_id for nd in nodes if isinstance(nd, ProposerNode)], np.int32
+        ),
+    }
+    latency = registry_network_latencies.get_by_name(params.latency)
+    city_index = getattr(latency, "city_index", None)
+    cols = build_node_columns(nodes, city_index)
+    proto = BatchedPaxos(params, roles)
+    net = BatchedNetwork(proto, latency, n, capacity=capacity)
+    state = net.init_state(cols, seed=seed, proto=proto.proto_init(n))
+    return net, state
