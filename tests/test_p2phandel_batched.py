@@ -1,0 +1,86 @@
+"""Batched P2PHandel: convergence, oracle parity, strategy behavior,
+determinism."""
+
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.engine import replicate_state
+from wittgenstein_tpu.protocols.p2phandel import P2PHandel, P2PHandelParameters
+from wittgenstein_tpu.protocols.p2phandel_batched import make_p2phandel
+
+
+def make_params(**kw):
+    base = dict(
+        signing_node_count=64,
+        relaying_node_count=8,
+        threshold=60,
+        connection_count=12,
+        pairing_time=20,
+        sigs_send_period=200,
+    )
+    base.update(kw)
+    return P2PHandelParameters(**base)
+
+
+def oracle_done(params, seeds, run_ms=8000):
+    out = []
+    for seed in seeds:
+        o = P2PHandel(params)
+        o.network().rd.set_seed(seed)
+        o.init()
+        o.network().run_ms(run_ms)
+        out += [n.done_at for n in o.network().all_nodes]
+    return np.asarray(out)
+
+
+class TestBatchedP2PHandel:
+    def test_oracle_parity(self):
+        """P50/P90 of doneAt within 10% of the oracle DES."""
+        p = make_params()
+        od = oracle_done(p, range(6))
+        assert (od > 0).all()
+        net, state = make_p2phandel(p)
+        states = replicate_state(state, 8)
+        out = net.run_ms_batched(states, 8000)
+        bd = np.asarray(out.done_at).ravel()
+        assert (bd > 0).all()
+        oq = np.percentile(od, [50, 90])
+        bq = np.percentile(bd, [50, 90])
+        rel = np.abs(bq - oq) / oq
+        assert (rel <= 0.10).all(), (oq, bq, rel)
+        assert int(np.asarray(out.dropped).max()) == 0
+
+    def test_relays_hold_no_own_sig(self):
+        """Relay nodes start without a signature of their own but still
+        aggregate to threshold (P2PHandel.java:264-266)."""
+        net, state = make_p2phandel(make_params())
+        relay = np.asarray(net.protocol.just_relay)
+        v0 = np.asarray(state.proto["verified"])
+        assert (np.diag(v0)[relay] == False).all()  # noqa: E712
+        assert (np.diag(v0)[~relay] == True).all()  # noqa: E712
+        out = net.run_ms(state, 8000)
+        assert (np.asarray(out.done_at) > 0).all()
+
+    def test_all_strategy_matches_dif_counts(self):
+        """'all' ships the full set instead of the diff; convergence is the
+        same (only wire sizes differ in the reference)."""
+        p_dif = make_params()
+        p_all = make_params(send_sigs_strategy="all")
+        n1, s1 = make_p2phandel(p_dif)
+        n2, s2 = make_p2phandel(p_all)
+        d1 = np.asarray(n1.run_ms(s1, 8000).done_at)
+        d2 = np.asarray(n2.run_ms(s2, 8000).done_at)
+        assert (d1 > 0).all() and (d2 > 0).all()
+        assert abs(np.median(d1) - np.median(d2)) / np.median(d1) <= 0.1
+
+    def test_check_sigs1_unsupported(self):
+        with pytest.raises(NotImplementedError):
+            make_p2phandel(make_params(double_aggregate_strategy=False))
+
+    def test_determinism(self):
+        net, state = make_p2phandel(make_params())
+        states = replicate_state(state, 4, seeds=[3, 4, 5, 6])
+        a = net.run_ms_batched(states, 6000)
+        da = np.asarray(a.done_at)
+        b = net.run_ms_batched(states, 6000)
+        assert (np.asarray(b.done_at) == da).all()

@@ -90,29 +90,10 @@ class BatchedPaxos(BatchedProtocol):
             "timeout_count": zi(),
         }
 
-    # -- proposer round start (startNextProposal, :313-338) ------------------
-    def _start_proposals(self, state, mask, proto):
-        """Reset in-progress state, pick the next seq, PROPOSE to every
-        acceptor and arm the timeout self-message."""
-        p = self.params
-        n = self.n_nodes
-        ids = jnp.arange(n, dtype=jnp.int32)
-        pc = p.proposer_count
-        gap = proto["seq_accepted"] % pc
-        cand = proto["seq_accepted"] + pc - gap + self.rank
-        new_seq = jnp.where(cand > proto["seq_ip"], cand, proto["seq_ip"] + pc)
-        seq_ip = jnp.where(mask, new_seq, proto["seq_ip"])
-        proto = dict(
-            proto,
-            seq_ip=seq_ip,
-            prop_ip=jnp.where(mask, True, proto["prop_ip"]),
-            asi=jnp.where(mask, NONE, proto["asi"]),
-            avi=jnp.where(mask, NONE, proto["avi"]),
-            agree_ip=jnp.where(mask, 0, proto["agree_ip"]),
-            rej1_ip=jnp.where(mask, 0, proto["rej1_ip"]),
-            accept_ip=jnp.where(mask, 0, proto["accept_ip"]),
-            rej2_ip=jnp.where(mask, 0, proto["rej2_ip"]),
-        )
+    def _proposal_emissions(self, seq_ip, mask, t):
+        """PROPOSE to every acceptor + the timeout self-message, shared by
+        the init path and round restarts (sent at t+1; timeout at
+        t+1+timeout, :329-338)."""
         ka = self.n_prop * self.n_acc
         em_prop = Emission(
             mask=jnp.repeat(mask[self.prop_ids], self.n_acc),
@@ -128,7 +109,6 @@ class BatchedPaxos(BatchedProtocol):
                 axis=1,
             ),
         )
-        # timeout: self-message at sent_time(+1) + timeout (:337-338)
         em_tmo = Emission(
             mask=mask[self.prop_ids],
             from_idx=self.prop_ids,
@@ -143,48 +123,39 @@ class BatchedPaxos(BatchedProtocol):
                 axis=1,
             ),
             arrival=jnp.broadcast_to(
-                state.time + 1 + p.timeout, (self.n_prop,)
+                t + 1 + self.params.timeout, (self.n_prop,)
             ).astype(jnp.int32),
         )
-        return proto, [em_prop, em_tmo]
+        return [em_prop, em_tmo]
+
+    # -- proposer round start (startNextProposal, :313-338) ------------------
+    def _start_proposals(self, state, mask, proto):
+        """Reset in-progress state, pick the next seq, PROPOSE to every
+        acceptor and arm the timeout self-message."""
+        pc = self.params.proposer_count
+        gap = proto["seq_accepted"] % pc
+        cand = proto["seq_accepted"] + pc - gap + self.rank
+        new_seq = jnp.where(cand > proto["seq_ip"], cand, proto["seq_ip"] + pc)
+        seq_ip = jnp.where(mask, new_seq, proto["seq_ip"])
+        proto = dict(
+            proto,
+            seq_ip=seq_ip,
+            prop_ip=jnp.where(mask, True, proto["prop_ip"]),
+            asi=jnp.where(mask, NONE, proto["asi"]),
+            avi=jnp.where(mask, NONE, proto["avi"]),
+            agree_ip=jnp.where(mask, 0, proto["agree_ip"]),
+            rej1_ip=jnp.where(mask, 0, proto["rej1_ip"]),
+            accept_ip=jnp.where(mask, 0, proto["accept_ip"]),
+            rej2_ip=jnp.where(mask, 0, proto["rej2_ip"]),
+        )
+        return proto, self._proposal_emissions(seq_ip, mask, state.time)
 
     def initial_emissions(self, net, state):
         """init: every proposer's first PROPOSE (sent at t=1) and its
         timeout — the state side is pre-baked in proto_init."""
-        seq_ip = state.proto["seq_ip"]
-        ka = self.n_prop * self.n_acc
-        em_prop = Emission(
-            mask=jnp.ones(ka, bool),
-            from_idx=jnp.repeat(self.prop_ids, self.n_acc),
-            to_idx=jnp.tile(self.acc_ids, self.n_prop),
-            mtype=self.mtype("PROPOSE"),
-            payload=jnp.stack(
-                [
-                    jnp.repeat(seq_ip[self.prop_ids], self.n_acc),
-                    jnp.zeros(ka, jnp.int32),
-                    jnp.zeros(ka, jnp.int32),
-                ],
-                axis=1,
-            ),
+        return self._proposal_emissions(
+            state.proto["seq_ip"], self.is_prop, state.time
         )
-        em_tmo = Emission(
-            mask=jnp.ones(self.n_prop, bool),
-            from_idx=self.prop_ids,
-            to_idx=self.prop_ids,
-            mtype=self.mtype("TIMEOUT"),
-            payload=jnp.stack(
-                [
-                    seq_ip[self.prop_ids],
-                    jnp.zeros(self.n_prop, jnp.int32),
-                    jnp.zeros(self.n_prop, jnp.int32),
-                ],
-                axis=1,
-            ),
-            arrival=jnp.broadcast_to(
-                state.time + 1 + self.params.timeout, (self.n_prop,)
-            ).astype(jnp.int32),
-        )
-        return [em_prop, em_tmo]
 
     def deliver(self, net, state, deliver_mask):
         p = self.params
@@ -264,8 +235,11 @@ class BatchedPaxos(BatchedProtocol):
         proto["accept_ip"] = count(is_acc, "accept_ip")
         proto["rej2_ip"] = count(is_rj2, "rej2_ip")
 
-        # AGREE (acceptedSeq, acceptedVal) bookkeeping: same-tick max (:255-259)
-        has_prev = is_agr & live & (p1 != NONE)
+        # AGREE (acceptedSeq, acceptedVal) bookkeeping: same-tick max
+        # (:255-259); gated on the pre-majority count like the oracle's
+        # `agree_count_ip < majority` entry guard — stragglers arriving
+        # after the COMMIT went out must not rewrite the committed value
+        has_prev = is_agr & live & (p1 != NONE) & (old_agree[to] < self.majority)
         pack = jnp.full(n, -1, jnp.int32).at[to].max(
             jnp.where(has_prev, p1 * VAL_PACK + jnp.clip(p2, 0, VAL_PACK - 1), -1),
             mode="drop",
