@@ -1,0 +1,661 @@
+"""Batched HandelEth2: multi-height Handel aggregation — three concurrent
+aggregation processes per node sharing one verification core, multi-value
+attestations (one bitset per head hash), exponential dissemination backoff.
+
+Reference semantics: protocols/handeleth2/ (HandelEth2.java, HNode.java,
+HLevel.java) via the oracle port `protocols/handeleth2.py`.
+
+TPU-first design:
+
+  * the three live processes (a new one every PERIOD_TIME=6000 ms, each
+    living 3 periods) occupy a rotating slot axis P=3, slot = height % 3;
+    starting height h and stopping height h-3 share a tick, exactly like
+    the oracle's same-ms start/stop tasks;
+  * multi-value contributions are a dense hash axis H=8: `create()`'s
+    geometric hash draw (80% hash 0, HNode.java:62-73) exceeds 7 with
+    probability 0.2^8 — clipped;
+  * per-(process, level) state is `[N, P, L, H, W]` packed who-bitsets
+    (incoming / individual / outgoing); cardinalities are derived by
+    popcount instead of incrementally maintained (level blocks hold
+    disjoint who-sets, so sums equal union sizes);
+  * updateAllOutgoing's running merge is a prefix scan over the level
+    axis; isOpen gates writes per level (HNode.java:208-231);
+  * the verification core is one register per node: the verify beat
+    (every nodePairingTime) selects by sizeIfMerged score — the window
+    is computed but unused in the reference ("bestInside" dead code,
+    HLevel.java:300-330) — and commits at t + pairingTime - 1;
+  * the to-verify pool is a K-slot buffer per (process, level); arrivals
+    land in the empty-or-worst slot by reception rank (the oracle's
+    unbounded list minus entries its curation would drop anyway);
+  * emission order: each node's per-level peer list (emission ranks,
+    HandelEth2.java:103-147) is baked from the oracle's init; the
+    get_remaining_peers cursor walk keeps the loop-detection
+    (lastCardinalitySent / firstNodeWithBestCard, HLevel.java:123-157)
+    for the single-destination cycle sends; fastPath bursts contact the
+    next levelCount eligible peers from the cursor.
+
+Scale note: state is O(N * P * L * H * N/32) words — right for the
+reference's 64-256 node eth2 committee sims, not for 4096 (plain Handel's
+packed single-value layout covers that regime)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.node import build_node_columns
+from ..core.registries import registry_network_latencies
+from ..engine import BatchedNetwork, BatchedProtocol, Emission
+from ..engine.rng import hash32, uniform_u01
+from ..ops.bitops import popcount_words
+from ..utils.more_math import log2
+from .handeleth2 import (
+    PERIOD_AGG_TIME,
+    PERIOD_TIME,
+    HandelEth2,
+    HandelEth2Parameters,
+)
+
+P = 3  # concurrent processes
+H = 8  # hash axis
+
+
+class BatchedHandelEth2(BatchedProtocol):
+    MSG_TYPES = ["AGG"]
+    TICK_INTERVAL = 1
+    CAND_SLOTS = 8
+
+    def __init__(self, params: HandelEth2Parameters, roles: dict):
+        self.params = params
+        self.n_nodes = params.node_count
+        self.lc = log2(self.n_nodes)  # levelCount
+        self.nl = self.lc + 1  # levels 0..levelCount
+        self.nw = max(1, self.n_nodes // 32)
+        # payload: height, level, own_hash, level_finished, atts[H*W]
+        self.PAYLOAD_WIDTH = 4 + H * self.nw
+        self.rr = jnp.asarray(roles["reception_ranks"], jnp.int32)  # [N, N]
+        # emission peer lists per level, -1 padded: [N, L, N/2]
+        self.peers = jnp.asarray(roles["peers"], jnp.int32)
+        self.pairing = jnp.asarray(roles["pairing"], jnp.int32)  # [N]
+
+    def msg_size(self, mtype: int) -> int:
+        return 1
+
+    def proto_init(self, n_nodes: int):
+        n, nl, nw, k = self.n_nodes, self.nl, self.nw, self.CAND_SLOTS
+        zi = lambda s: jnp.zeros(s, jnp.int32)
+        return {
+            "height": jnp.zeros((n, P), jnp.int32),  # 0 = inactive slot
+            "own_hash": zi((n, P)),
+            "start_at": zi((n, P)),
+            "fin_peers": jnp.zeros((n, P, nw), jnp.uint32),
+            "rr_bump": zi((n, P, n)),
+            "inc": jnp.zeros((n, P, nl, H, nw), jnp.uint32),
+            "ind": jnp.zeros((n, P, nl, H, nw), jnp.uint32),
+            "out": jnp.zeros((n, P, nl, H, nw), jnp.uint32),
+            "out_fin": jnp.zeros((n, P, nl), bool),
+            "last_sent": jnp.full((n, P, nl), -1, jnp.int32),
+            "first_best": jnp.full((n, P, nl), -1, jnp.int32),
+            "contacted": zi((n, P, nl)),
+            "cycle_ct": zi((n, P, nl)),
+            "pos": zi((n, P, nl)),
+            # to-verify buffer
+            "c_rank": jnp.full((n, P, nl, k), 2**31 - 1, jnp.int32),
+            "c_from": zi((n, P, nl, k)),
+            "c_hash": zi((n, P, nl, k)),
+            "c_atts": jnp.zeros((n, P, nl, k, H, nw), jnp.uint32),
+            # shared verification core
+            "v_active": jnp.zeros(n, bool),
+            "v_done_t": zi(n),
+            "v_proc": zi(n),
+            "v_level": zi(n),
+            "v_from": zi(n),
+            "v_hash": zi(n),
+            "v_height": zi(n),
+            "v_atts": jnp.zeros((n, H, nw), jnp.uint32),
+            "last_vproc_h": zi(n),  # lastVerified process height
+            "last_lvl": jnp.full((n, P), 2, jnp.int32),
+            "window": jnp.full(n, 16, jnp.int32),
+            "agg_done": zi(n),
+            "contrib_total": zi(n),
+            "next_height": jnp.full(n, 1001, jnp.int32),
+        }
+
+    # -- helpers -------------------------------------------------------------
+    def _onehot_w(self, idx):
+        cols = jnp.arange(self.nw, dtype=jnp.int32)
+        bit = (jnp.uint32(1) << (idx % 32).astype(jnp.uint32)).astype(jnp.uint32)
+        return jnp.where(cols == (idx // 32)[..., None], bit[..., None], jnp.uint32(0))
+
+    def _card(self, who):  # popcount over (H, W) trailing axes
+        return popcount_words(who.reshape(who.shape[:-2] + (-1,)))
+
+    def _size_if_merged(self, inc_l, ind_l, cand):
+        """sizeIfMerged (HLevel.java:160-196), vectorized over any leading
+        axes: inc_l/ind_l [..., H, W], cand [..., H, W]."""
+        our_c = popcount_words(inc_l)  # [..., H]
+        av_c = popcount_words(cand)
+        inter = popcount_words(inc_l & cand) > 0
+        merged = popcount_words(ind_l | cand)
+        per_hash = jnp.where(
+            our_c == 0,
+            av_c,
+            jnp.where(~inter, our_c + av_c, jnp.maximum(merged, our_c)),
+        )
+        # hashes where the candidate has nothing keep our contribution
+        per_hash = jnp.where(av_c == 0, our_c, per_hash)
+        return jnp.sum(per_hash, axis=-1)
+
+    def _next_peer(self, proto, sel_p, sel_l, count):
+        """get_remaining_peers for `count` destinations from the cursor,
+        skipping finished peers (blacklist is empty: nothing ever fails
+        verification).  Returns (dests [N, count], ok [N, count])."""
+        n = self.n_nodes
+        ids = jnp.arange(n)
+        mp = self.peers.shape[2]
+        plist = self.peers[ids, jnp.clip(sel_l, 0, self.nl - 1)]  # [N, mp]
+        pos = proto["pos"][ids, sel_p, sel_l]
+        fin = proto["fin_peers"][ids, sel_p]  # [N, nw]
+        pv = jnp.clip(plist, 0, n - 1)
+        fbit = (fin[jnp.arange(n)[:, None], pv // 32] >> (pv % 32).astype(jnp.uint32)) & 1
+        eligible = (plist >= 0) & (fbit == 0)
+        # rotate eligibility by pos and take the first `count`
+        idxs = (pos[:, None] + jnp.arange(mp)[None, :]) % jnp.maximum(
+            1, jnp.sum(plist >= 0, axis=1)
+        )[:, None]
+        rot_ok = jnp.take_along_axis(eligible, idxs, axis=1)
+        rot_peer = jnp.take_along_axis(plist, idxs, axis=1)
+        # rank eligible entries in rotated order
+        order = jnp.cumsum(rot_ok.astype(jnp.int32), axis=1)
+        dests, oks, steps = [], [], []
+        for j in range(count):
+            hit = rot_ok & (order == j + 1)
+            any_hit = jnp.any(hit, axis=1)
+            first = jnp.argmax(hit, axis=1)
+            dests.append(
+                jnp.where(any_hit, rot_peer[jnp.arange(n), first], 0)
+            )
+            oks.append(any_hit)
+            steps.append(jnp.where(any_hit, first + 1, 0))
+        return (
+            jnp.stack(dests, 1),
+            jnp.stack(oks, 1),
+            jnp.max(jnp.stack(steps, 1), axis=1),
+        )
+
+    # -- per-tick ------------------------------------------------------------
+    def tick(self, net, state):
+        p = self.params
+        proto = dict(state.proto)
+        t = state.time
+        n, nl, nw, k = self.n_nodes, self.nl, self.nw, self.CAND_SLOTS
+        ids = jnp.arange(n, dtype=jnp.int32)
+        live = ~state.down
+
+        # ---- 1. verification commits (update at t = beat + pairing - 1) ---
+        proto, ems_fp = self._commit(net, state, proto)
+
+        # ---- 2. process start/stop beat (every PERIOD_TIME) ----------------
+        beat_start = live & (t >= 1) & ((t - 1) % PERIOD_TIME == 0)
+        proto = self._start_stop(state, proto, beat_start)
+
+        # ---- 3. dissemination beat (every period_duration_ms) --------------
+        beat_diss = live & (t >= 1) & ((t - 1) % p.period_duration_ms == 0)
+        proto, ems = self._dissemination(state, proto, beat_diss)
+
+        # ---- 4. verify beat (every nodePairingTime) ------------------------
+        beat_ver = live & (t >= 1) & ((t - 1) % self.pairing == 0)
+        proto = self._select(state, proto, beat_ver)
+
+        state = state._replace(proto=proto)
+        for em in ems_fp + ems:
+            state = net.apply_emission(state, em)
+        return state
+
+    def _start_stop(self, state, proto, beat):
+        """startNewAggregation + the expiring slot's stopAggregation
+        (HNode.java:111-145, 468-486)."""
+        n, nl, nw = self.n_nodes, self.nl, self.nw
+        ids = jnp.arange(n, dtype=jnp.int32)
+        h_new = proto["next_height"]
+        slot = h_new % P
+        old_h = proto["height"][ids, slot]
+        stopping = beat & (old_h > 0)
+        # contributionsTotal += last level's incoming+outgoing cardinality
+        last_inc = proto["inc"][ids, slot, nl - 1]
+        last_out = proto["out"][ids, slot, nl - 1]
+        best = self._card(last_inc) + self._card(last_out)
+        proto["contrib_total"] = proto["contrib_total"] + jnp.where(
+            stopping, best, 0
+        )
+        proto["agg_done"] = proto["agg_done"] + stopping.astype(jnp.int32)
+
+        # own hash: geometric (80% h=0) from the counter RNG
+        hsh = jnp.zeros(n, jnp.int32)
+        cont = jnp.ones(n, bool)
+        for j in range(H - 1):
+            u = uniform_u01(state.seed, jnp.int32(0xE717), ids, h_new, jnp.int32(j))
+            cont = cont & (u < 0.2)
+            hsh = hsh + cont.astype(jnp.int32)
+
+        # reset the slot
+        def slot_set(name, new_val):
+            proto[name] = proto[name].at[ids, slot].set(
+                jnp.where(
+                    beat.reshape((n,) + (1,) * (proto[name].ndim - 2)),
+                    new_val,
+                    proto[name][ids, slot],
+                ),
+                mode="drop",
+            )
+
+        slot_set("height", jnp.where(beat, h_new, old_h))
+        slot_set("own_hash", hsh)
+        slot_set("start_at", jnp.broadcast_to(state.time, (n,)))
+        slot_set("fin_peers", jnp.zeros((n, nw), jnp.uint32))
+        slot_set("rr_bump", jnp.zeros((n, n), jnp.int32))
+        inc0 = jnp.zeros((n, nl, H, nw), jnp.uint32)
+        own_bit = self._onehot_w(ids)  # [N, nw]
+        inc0 = inc0.at[ids, 0, hsh].set(own_bit)
+        slot_set("inc", inc0)
+        slot_set("ind", inc0)
+        slot_set("out", jnp.zeros((n, nl, H, nw), jnp.uint32))
+        of0 = jnp.zeros((n, nl), bool).at[:, 0].set(True)
+        slot_set("out_fin", of0)
+        slot_set("last_sent", jnp.full((n, nl), -1, jnp.int32))
+        slot_set("first_best", jnp.full((n, nl), -1, jnp.int32))
+        slot_set("contacted", jnp.zeros((n, nl), jnp.int32))
+        slot_set("cycle_ct", jnp.zeros((n, nl), jnp.int32))
+        slot_set("pos", jnp.zeros((n, nl), jnp.int32))
+        slot_set("c_rank", jnp.full((n, nl, self.CAND_SLOTS), 2**31 - 1, jnp.int32))
+        slot_set("last_lvl", jnp.full((n,), 2, jnp.int32))
+        proto["next_height"] = jnp.where(beat, h_new + 1, h_new)
+        return proto
+
+    def _update_all_outgoing(self, proto, mask, now):
+        """Prefix merge over levels for OPEN levels (HNode.java:208-231);
+        mask [N, P] selects the processes to refresh."""
+        # prefix[l] = union of incoming[0..l-1]
+        inc = proto["inc"]  # [N, P, L, H, W]
+        # OR-prefix over the level axis
+        pre = lax.associative_scan(jnp.bitwise_or, inc, axis=2)
+        shifted = jnp.concatenate(
+            [jnp.zeros_like(pre[:, :, :1]), pre[:, :, :-1]], axis=2
+        )
+        is_open = self._is_open(proto, now)
+        upd = mask[:, :, None] & is_open
+        proto["out"] = jnp.where(upd[..., None, None], shifted, proto["out"])
+        return proto
+
+    def _is_open(self, proto, now):
+        """isOpen per (N, P, L) (HLevel.java:106-117)."""
+        nl = self.nl
+        lr = jnp.arange(nl, dtype=jnp.int32)
+        elapsed = proto["start_at"][:, :, None]
+        return ~proto["out_fin"] & (
+            (now - elapsed >= (lr[None, None, :] - 1) * self.params.level_wait_time)
+            | (self._out_complete(proto))
+        ) & (proto["height"][:, :, None] > 0) & (lr[None, None, :] > 0)
+
+    def _out_complete(self, proto):
+        lr = jnp.arange(self.nl, dtype=jnp.int32)
+        peers_ct = jnp.where(lr == 0, 1, 1 << jnp.maximum(lr - 1, 0))
+        return self._card(proto["out"]) == peers_ct[None, None, :]
+
+    def _inc_complete(self, proto):
+        lr = jnp.arange(self.nl, dtype=jnp.int32)
+        peers_ct = jnp.where(lr == 0, 1, 1 << jnp.maximum(lr - 1, 0))
+        return self._card(proto["inc"]) == peers_ct[None, None, :]
+
+    def _dissemination(self, state, proto, beat):
+        """doCycle over open levels of every live process
+        (HNode.java:440-445, HLevel.java:80-93)."""
+        p = self.params
+        n, nl = self.n_nodes, self.nl
+        ids = jnp.arange(n, dtype=jnp.int32)
+        proto = self._update_all_outgoing(
+            proto, beat[:, None] & (proto["height"] > 0), state.time
+        )
+        is_open = self._is_open(proto, state.time)
+        proto["cycle_ct"] = proto["cycle_ct"] + (
+            beat[:, None, None] & is_open
+        ).astype(jnp.int32)
+        m = proto["contacted"] // self.lc
+        period = jnp.power(jnp.int32(3), jnp.clip(m, 0, 9))
+        fire = beat[:, None, None] & is_open & (
+            lax.rem(proto["cycle_ct"], period) == 0
+        )
+
+        ems = []
+        for pi in range(P):
+            pia = jnp.full(n, pi, jnp.int32)
+            for l in range(1, nl):
+                la = jnp.full(n, l, jnp.int32)
+                f = fire[:, pi, l]
+                dest, ok, step = self._next_peer(proto, pia, la, 1)
+                d0, ok0 = dest[:, 0], ok[:, 0] & f
+                # loop detection: same content to the same first peer
+                card = self._card(proto["out"][ids, pi, l])
+                looped = (card == proto["last_sent"][ids, pi, l]) & (
+                    d0 == proto["first_best"][ids, pi, l]
+                )
+                send = ok0 & ~looped
+                proto["pos"] = proto["pos"].at[ids, pi, l].add(
+                    jnp.where(send, step, 0)
+                )
+                proto["contacted"] = proto["contacted"].at[ids, pi, l].add(
+                    send.astype(jnp.int32)
+                )
+                newbest = send & (card > proto["last_sent"][ids, pi, l])
+                proto["first_best"] = proto["first_best"].at[ids, pi, l].set(
+                    jnp.where(newbest, d0, proto["first_best"][ids, pi, l])
+                )
+                proto["last_sent"] = proto["last_sent"].at[ids, pi, l].set(
+                    jnp.where(newbest, card, proto["last_sent"][ids, pi, l])
+                )
+                ems.append(
+                    self._agg_emission(proto, send[:, None], d0[:, None], pia, la)
+                )
+        return proto, ems
+
+    def _agg_emission(self, proto, masks, dests, proc_idx, lvl_idx):
+        """SendAggregation(level, ownHash, levelFinished, outgoing) to D
+        destinations per node; proc_idx/lvl_idx are [N] dynamic indices."""
+        n = self.n_nodes
+        d = dests.shape[1]
+        ids = jnp.arange(n, dtype=jnp.int32)
+        out_l = proto["out"][ids, proc_idx, lvl_idx]  # [N, H, nw]
+        inc_c = self._inc_complete(proto)[ids, proc_idx, lvl_idx]
+        payload = jnp.concatenate(
+            [
+                proto["height"][ids, proc_idx][:, None],
+                lvl_idx[:, None],
+                proto["own_hash"][ids, proc_idx][:, None],
+                inc_c[:, None].astype(jnp.int32),
+                out_l.reshape(n, H * self.nw).astype(jnp.int32),
+            ],
+            axis=1,
+        )
+        nonempty = self._card(out_l) > 0
+        return Emission(
+            mask=(masks & nonempty[:, None]).reshape(-1),
+            from_idx=jnp.repeat(ids, d),
+            to_idx=jnp.clip(dests, 0, n - 1).reshape(-1),
+            mtype=self.mtype("AGG"),
+            payload=jnp.repeat(payload, d, axis=0),
+        )
+
+    # -- arrivals (onNewAgg, HNode.java:317-349) ----------------------------
+    def deliver(self, net, state, deliver_mask):
+        proto = dict(state.proto)
+        n, nl, nw, k = self.n_nodes, self.nl, self.nw, self.CAND_SLOTS
+        c = deliver_mask.shape[0]
+        to, frm = state.msg_to, state.msg_from
+        pay = state.msg_payload
+        mh, ml = pay[:, 0], jnp.clip(pay[:, 1], 0, nl - 1)
+        mhash, mfin = jnp.clip(pay[:, 2], 0, H - 1), pay[:, 3] == 1
+        slot = (mh % P).astype(jnp.int32)
+        ok = deliver_mask & (proto["height"][to, slot] == mh) & (mh > 0)
+
+        # levelFinished -> finished_peers bit
+        fin_bit = self._onehot_w(frm)  # [C, nw]
+        w_to = jnp.where(ok & mfin, to, n)
+        proto["fin_peers"] = proto["fin_peers"].at[w_to, slot].max(
+            fin_bit, mode="drop"
+        )
+
+        # reception rank, then bump (HNode.java:338-341)
+        rank = self.rr[to, frm] + proto["rr_bump"][to, slot, frm] * n
+        proto["rr_bump"] = proto["rr_bump"].at[
+            jnp.where(ok, to, n), slot, frm
+        ].add(1, mode="drop")
+
+        # insert into the to-verify buffer unless the level is complete;
+        # winner-per-slot: lowest ring index fills the worst buffer slot
+        inc_c = self._inc_complete(proto)[to, slot, ml]
+        want = ok & ~inc_c
+        atts = self._unpack_atts(pay)  # [C, H, nw]
+        # one insertion per (node, proc, level) per tick: lowest ring slot
+        ringslot = jnp.arange(c, dtype=jnp.int32)
+        win = jnp.full((n, P, nl), c, jnp.int32)
+        win = win.at[to, slot, ml].min(
+            jnp.where(want, ringslot, c), mode="drop"
+        )
+        is_win = want & (win[to, slot, ml] == ringslot)
+        # worst existing buffer slot by rank (max); replace if empty/worse
+        worst = jnp.argmax(proto["c_rank"][to, slot, ml], axis=1)
+        worst_rank = jnp.take_along_axis(
+            proto["c_rank"][to, slot, ml], worst[:, None], axis=1
+        )[:, 0]
+        do_ins = is_win & (rank < worst_rank)
+        wi_to = jnp.where(do_ins, to, n)
+        proto["c_rank"] = proto["c_rank"].at[wi_to, slot, ml, worst].set(
+            rank, mode="drop"
+        )
+        proto["c_from"] = proto["c_from"].at[wi_to, slot, ml, worst].set(
+            frm, mode="drop"
+        )
+        proto["c_hash"] = proto["c_hash"].at[wi_to, slot, ml, worst].set(
+            mhash, mode="drop"
+        )
+        proto["c_atts"] = proto["c_atts"].at[wi_to, slot, ml, worst].set(
+            atts, mode="drop"
+        )
+        return state._replace(proto=proto), []
+
+    def _unpack_atts(self, pay):
+        c = pay.shape[0]
+        return pay[:, 4 : 4 + H * self.nw].astype(jnp.uint32).reshape(c, H, self.nw)
+
+    # -- verification core ---------------------------------------------------
+    def _select(self, state, proto, beat):
+        """verify (HNode.java:262-287) + AggregationProcess.bestToVerify
+        (:148-175): next-height process first, else min height; level 1
+        first, then the cycling level cursor."""
+        n, nl, k = self.n_nodes, self.nl, self.CAND_SLOTS
+        ids = jnp.arange(n, dtype=jnp.int32)
+        free = beat & ~proto["v_active"] & jnp.any(proto["height"] > 0, axis=1)
+
+        # candidate scores per (proc, level, slot), curated
+        inc_c = self._inc_complete(proto)  # [N, P, L]
+        valid = proto["c_rank"] < 2**31 - 1
+        scores = self._size_if_merged(
+            proto["inc"][:, :, :, None], proto["ind"][:, :, :, None], proto["c_atts"]
+        )  # [N, P, L, K]
+        cur_card = self._card(proto["inc"])  # [N, P, L]
+        keep = valid & (scores > cur_card[..., None]) & ~inc_c[..., None]
+        # purge: completed levels clear their buffers; non-improving drop
+        proto["c_rank"] = jnp.where(keep, proto["c_rank"], 2**31 - 1)
+
+        # best slot per (proc, level) by score
+        sl_best = jnp.argmax(jnp.where(keep, scores, -1), axis=3)
+        sl_score = jnp.take_along_axis(
+            jnp.where(keep, scores, -1), sl_best[..., None], axis=3
+        )[..., 0]
+        has = sl_score > 0  # [N, P, L]
+
+        # choose the process: lastVerified.height+1 if it has work, else
+        # the minimum active height (approximation of the cursor: the
+        # reference retries the same process until success)
+        hts = proto["height"]  # [N, P]
+        has_proc = jnp.any(has, axis=2)
+        next_h = proto["last_vproc_h"] + 1
+        is_next = (hts == next_h[:, None]) & (hts > 0) & has_proc
+        minh = jnp.min(jnp.where((hts > 0) & has_proc, hts, 2**30), axis=1)
+        is_min = (hts == minh[:, None]) & has_proc
+        pick = jnp.where(jnp.any(is_next, axis=1)[:, None], is_next, is_min)
+        proc_sel = jnp.argmax(pick, axis=1)
+        proc_ok = jnp.any(pick, axis=1) & free
+
+        # level: 1 first, else cycle from last_lvl (:148-175)
+        has_p = has[ids, proc_sel]  # [N, L]
+        lvl1 = has_p[:, 1] if nl > 1 else jnp.zeros(n, bool)
+        start = jnp.clip(proto["last_lvl"][ids, proc_sel], 2, nl - 1)
+        offs = jnp.arange(nl, dtype=jnp.int32)
+        rot = 2 + lax.rem(start[:, None] - 2 + offs[None, :], jnp.maximum(1, nl - 2))
+        rot_has = jnp.take_along_axis(
+            has_p, jnp.clip(rot, 0, nl - 1), axis=1
+        )
+        first = jnp.argmax(rot_has, axis=1)
+        lvl_cyc = jnp.take_along_axis(
+            jnp.clip(rot, 0, nl - 1), first[:, None], axis=1
+        )[:, 0]
+        lvl_sel = jnp.where(lvl1, 1, lvl_cyc)
+        lvl_ok = lvl1 | jnp.any(rot_has, axis=1)
+        go = proc_ok & lvl_ok
+
+        ks = sl_best[ids, proc_sel, lvl_sel]
+        proto["last_vproc_h"] = jnp.where(
+            go, proto["height"][ids, proc_sel], proto["last_vproc_h"]
+        )
+        proto["last_lvl"] = proto["last_lvl"].at[ids, proc_sel].set(
+            jnp.where(go & ~lvl1, lvl_sel, proto["last_lvl"][ids, proc_sel]),
+            mode="drop",
+        )
+        proto["v_active"] = proto["v_active"] | go
+        proto["v_done_t"] = jnp.where(
+            go, state.time + self.pairing - 1, proto["v_done_t"]
+        )
+        proto["v_proc"] = jnp.where(go, proc_sel, proto["v_proc"])
+        proto["v_level"] = jnp.where(go, lvl_sel, proto["v_level"])
+        proto["v_from"] = jnp.where(
+            go, proto["c_from"][ids, proc_sel, lvl_sel, ks], proto["v_from"]
+        )
+        proto["v_hash"] = jnp.where(
+            go, proto["c_hash"][ids, proc_sel, lvl_sel, ks], proto["v_hash"]
+        )
+        proto["v_height"] = jnp.where(
+            go, proto["height"][ids, proc_sel], proto["v_height"]
+        )
+        proto["v_atts"] = jnp.where(
+            go[:, None, None],
+            proto["c_atts"][ids, proc_sel, lvl_sel, ks],
+            proto["v_atts"],
+        )
+        # consume the buffer slot
+        proto["c_rank"] = proto["c_rank"].at[
+            jnp.where(go, ids, n), proc_sel, lvl_sel, ks
+        ].set(2**31 - 1, mode="drop")
+        return proto
+
+    def _commit(self, net, state, proto):
+        """updateVerifiedSignatures (HNode.java:181-205): merge, window
+        growth, fastPath on level completion."""
+        p = self.params
+        n, nl, nw = self.n_nodes, self.nl, self.nw
+        ids = jnp.arange(n, dtype=jnp.int32)
+        due = proto["v_active"] & (state.time >= proto["v_done_t"])
+        pi, l = proto["v_proc"], proto["v_level"]
+        # the slot may have rotated to the NEXT height since selection —
+        # match the height captured at selection, not just slot liveness
+        still = due & (proto["height"][ids, pi] == proto["v_height"]) & (
+            proto["v_height"] > 0
+        )
+        proto["v_active"] = proto["v_active"] & ~due
+
+        inc_l = proto["inc"][ids, pi, l]  # [N, H, nw]
+        ind_l = proto["ind"][ids, pi, l]
+        cand = proto["v_atts"]
+        # merge_incoming (HLevel.java:228-262) per hash
+        our_c = popcount_words(inc_l)
+        av_c = popcount_words(cand)
+        inter = popcount_words(inc_l & cand) > 0
+        merged_ind = ind_l | cand
+        use_cand = (our_c == 0) | (~inter)
+        grow = popcount_words(merged_ind) > our_c
+        new_inc = jnp.where(
+            (av_c > 0)[..., None],
+            jnp.where(
+                use_cand[..., None],
+                inc_l | cand,
+                jnp.where(grow[..., None], merged_ind, inc_l),
+            ),
+            inc_l,
+        )
+        new_ind = ind_l.at[jnp.arange(n), proto["v_hash"]].max(
+            self._onehot_w(proto["v_from"])
+        )
+        proto["inc"] = proto["inc"].at[jnp.where(still, ids, n), pi, l].set(
+            new_inc, mode="drop"
+        )
+        proto["ind"] = proto["ind"].at[jnp.where(still, ids, n), pi, l].set(
+            new_ind, mode="drop"
+        )
+        proto["window"] = jnp.where(
+            still, jnp.minimum(128, proto["window"] * 2), proto["window"]
+        )
+
+        # fastPath: completing a level bursts the now-complete outgoing of
+        # HIGHER levels to levelCount peers each (HNode.java:195-203; the
+        # top level is excluded by the reference's bound, kept bug-for-bug)
+        proto = self._update_all_outgoing(
+            proto,
+            jnp.zeros((n, P), bool).at[ids, pi].max(still, mode="drop"),
+            state.time,
+        )
+        inc_done = self._inc_complete(proto)[ids, pi, l] & still & (l < self.lc)
+        ems = []
+        out_c = self._out_complete(proto)
+        for lu in range(2, nl - 1):
+            la = jnp.full(n, lu, jnp.int32)
+            m = inc_done & (lu > l) & out_c[ids, pi, lu]
+            dests, oks, step = self._next_peer(proto, pi, la, self.lc)
+            rows = m[:, None] & oks
+            proto["pos"] = proto["pos"].at[ids, pi, lu].add(jnp.where(m, step, 0))
+            proto["contacted"] = proto["contacted"].at[ids, pi, lu].add(
+                jnp.sum(rows, axis=1).astype(jnp.int32)
+            )
+            ems.append(self._agg_emission(proto, rows, dests, pi, la))
+        return proto, ems
+
+    def all_done(self, state):
+        return jnp.asarray(False)
+
+
+def make_handeleth2(
+    params: Optional[HandelEth2Parameters] = None,
+    capacity: int = 1 << 14,
+    seed: int = 0,
+):
+    """Host-side construction from the oracle init (reception + emission
+    ranks use the same JavaRandom stream)."""
+    params = params or HandelEth2Parameters()
+    if params.desynchronized_start:
+        raise NotImplementedError(
+            "batched HandelEth2 runs all beats in phase (delta_start=0)"
+        )
+    oracle = HandelEth2(params)
+    oracle.init()
+    nodes = oracle.network().all_nodes
+    n = len(nodes)
+    lc = log2(n)
+    rr = np.zeros((n, n), np.int32)
+    for nd in nodes:
+        rr[nd.node_id] = nd.reception_ranks
+    mp = max(1, n // 2)
+    peers = np.full((n, lc + 1, mp), -1, np.int32)
+    for nd in nodes:
+        if nd.is_down():
+            continue
+        for l in range(1, lc + 1):
+            for j, pr in enumerate(nd.peers_per_level[l]):
+                peers[nd.node_id, l, j] = pr.node_id
+    pairing = np.array(
+        [max(1, getattr(nd, "node_pairing_time", params.pairing_time)) for nd in nodes],
+        np.int32,
+    )
+    roles = {"reception_ranks": rr, "peers": peers, "pairing": pairing}
+    latency = registry_network_latencies.get_by_name(params.network_latency_name)
+    city_index = getattr(latency, "city_index", None)
+    cols = build_node_columns(nodes, city_index)
+    proto = BatchedHandelEth2(params, roles)
+    net = BatchedNetwork(proto, latency, n, capacity=capacity)
+    down = np.array([nd.is_down() for nd in nodes])
+    state = net.init_state(
+        cols, seed=seed, proto=proto.proto_init(n), down=down
+    )
+    return net, state
