@@ -138,10 +138,16 @@ def bench_batched(node_ct: int, n_replicas: int) -> dict:
     from wittgenstein_tpu.protocols.handel_batched import make_handel
 
     # persistent compile cache: the big per-tick graphs take 30-120 s to
-    # compile on the tunneled backend; cache hits skip that on re-runs
+    # compile on the tunneled backend; cache hits skip that on re-runs.
+    # Separate dirs per backend — axon-session processes write CPU AOT
+    # entries with mismatched machine-feature flags (prefer-no-scatter),
+    # which the loader warns may SIGILL on plain-CPU runs
+    default_cache = (
+        ".jax_cache_tpu" if jax.default_backend() == "tpu" else ".jax_cache"
+    )
     jax.config.update(
         "jax_compilation_cache_dir",
-        os.path.abspath(os.environ.get("WITT_BENCH_CACHE", ".jax_cache_tpu")),
+        os.path.abspath(os.environ.get("WITT_BENCH_CACHE", default_cache)),
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
@@ -273,6 +279,12 @@ def main() -> None:
                 "compile_s": result["compile_s"],
                 "run_s": result["run_s"],
                 "oracle_sims_per_sec": round(oracle, 4),
+                "workload": (
+                    "handel-full: windowed scoring, Byzantine attack machinery,"
+                    " fastPath, per-node pairing — the r1/r2 bench ran the"
+                    " pre-rewrite lite engine, so values are not comparable"
+                    " across rounds"
+                ),
                 "probe": probe,
                 "bench_error": bench_error,
             }
