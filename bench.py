@@ -229,7 +229,7 @@ def main() -> None:
         ladder = [(ladder[0][0], int(os.environ["WITT_BENCH_REPLICAS"]), ladder[0][2])]
 
     result, errors = None, []
-    for node_ct, n_replicas, rung_timeout in ladder:
+    for i, (node_ct, n_replicas, rung_timeout) in enumerate(ladder):
         if platform != "tpu":
             try:
                 result = bench_batched(node_ct, n_replicas)
@@ -242,6 +242,31 @@ def main() -> None:
             result = r
             break
         errors.append(r["error"])
+        if i == len(ladder) - 1:
+            break  # nothing left for a health probe to protect
+        # a big-program crash can WEDGE the worker: every later rung would
+        # then hang for its full timeout.  One health probe (same budget as
+        # the backend probe: init can take ~150 s) decides whether the rest
+        # of the ladder is worth attempting.
+        try:
+            hp = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax, numpy; d = jax.devices()[0];"
+                    " print(d.platform, int(numpy.asarray(jax.numpy.arange(4).sum())))",
+                ],
+                timeout=PROBE_TIMEOUT_S,
+                capture_output=True,
+                text=True,
+            )
+            last = hp.stdout.strip().splitlines()[-1] if hp.stdout.strip() else ""
+            healthy = hp.returncode == 0 and last == "tpu 6"
+        except subprocess.TimeoutExpired:
+            healthy = False
+        if not healthy:
+            errors.append("worker unhealthy after rung failure; skipping remaining rungs")
+            break
     bench_error = "; ".join(errors) if errors else None
     if result is None:
         print(
