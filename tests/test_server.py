@@ -168,3 +168,18 @@ class TestWServer:
         assert post(base_url, "/w/network/runMs/400")[0] == 200
         _, n0 = get(base_url, "/w/network/nodes/0")
         assert n0["msgReceived"] > 0
+
+
+class TestStaticUI:
+    def test_index_served(self, base_url):
+        """The browser UI (reference wserver static/index.html analog) is
+        served at / and /index.html with the protocol/param/run controls."""
+        for path in ("/", "/index.html"):
+            with urllib.request.urlopen(base_url + path, timeout=60) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/html")
+                page = r.read().decode()
+            assert "protocolsList" in page  # protocol list pane
+            assert "protocolParameters" in page  # editable params pane
+            assert "/network/init/" in page  # init wiring
+            assert "runMs" in page and "nodeStatus" in page

@@ -32,9 +32,12 @@ import json
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
 from typing import Optional, Tuple
 
 from .server import Server
+
+_STATIC_DIR = Path(__file__).parent / "static"
 
 _ROUTES = []
 
@@ -184,16 +187,30 @@ class WServer:
 class _Handler(BaseHTTPRequestHandler):
     ws: WServer  # set by serve()
 
-    def _do(self, method: str):
-        length = int(self.headers.get("Content-Length") or 0)
-        body = self.rfile.read(length).decode() if length else ""
-        status, payload = self.ws.dispatch(method, self.path, body)
-        data = json.dumps(payload).encode()
+    def _respond(self, status: int, content_type: str, data: bytes):
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _do(self, method: str):
+        # the browser UI (analog of the reference's static/index.html,
+        # served from wserver resources by spring-boot)
+        if method == "GET" and self.path in ("/", "/index.html"):
+            try:
+                page = (_STATIC_DIR / "index.html").read_bytes()
+            except OSError as e:
+                self._respond(
+                    500, "application/json", json.dumps({"error": str(e)}).encode()
+                )
+                return
+            self._respond(200, "text/html; charset=utf-8", page)
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length).decode() if length else ""
+        status, payload = self.ws.dispatch(method, self.path, body)
+        self._respond(status, "application/json", json.dumps(payload).encode())
 
     def do_GET(self):
         self._do("GET")
