@@ -47,8 +47,10 @@ if CHUNK_MS <= 0 or SIM_MS % CHUNK_MS != 0:
     raise SystemExit(
         f"WITT_BENCH_CHUNK_MS={CHUNK_MS} must be a positive divisor of {SIM_MS}"
     )
-PROBE_ATTEMPTS = 3
-PROBE_TIMEOUT_S = 150
+# a dead tunnel HANGS (never raises), so probe budget is pure deadweight
+# when the chip is gone: 2 x 120 s (r3 burned 3 x 150 s before fallback)
+PROBE_ATTEMPTS = 2
+PROBE_TIMEOUT_S = 120
 
 
 def _probe_backend() -> dict:
@@ -220,9 +222,17 @@ def main() -> None:
     device_kind = getattr(devs[0], "device_kind", "?")
 
     if platform == "tpu":
-        # 4096 first (the north-star size; its compile can wedge the
-        # worker, hence the subprocess watchdogs), then known-good rungs
-        ladder = [(4096, 32, 1500), (4096, 8, 900), (2048, 16, 900), (1024, 16, 700)]
+        # 4096 first (the north-star size); the r4 width-bucket rewrite cut
+        # the per-tick program ~3x (9.8k StableHLO lines at 4096, 14 s CPU
+        # compile), so the compile that wedged the r3 worker should now fit
+        # inside the RPC watchdog — subprocess timeouts still guard it
+        ladder = [
+            (4096, 32, 1200),
+            (4096, 16, 900),
+            (4096, 8, 900),
+            (2048, 16, 700),
+            (1024, 16, 600),
+        ]
     else:
         ladder = [(256, 4, 900)]
     if os.environ.get("WITT_BENCH_REPLICAS"):
@@ -306,9 +316,10 @@ def main() -> None:
                 "oracle_sims_per_sec": round(oracle, 4),
                 "workload": (
                     "handel-full: windowed scoring, Byzantine attack machinery,"
-                    " fastPath, per-node pairing — the r1/r2 bench ran the"
-                    " pre-rewrite lite engine, so values are not comparable"
-                    " across rounds"
+                    " fastPath, per-node pairing.  r4 rewrote the engine onto"
+                    " stacked width-bucket bodies (same semantics, ~3x smaller"
+                    " XLA program) — comparable to r3, not to the r1/r2 lite"
+                    " engine"
                 ),
                 "probe": probe,
                 "bench_error": bench_error,

@@ -82,3 +82,78 @@ class TestBatchedCasper:
         assert (ha.max(axis=1) >= 3).all()
         b = net.run_ms_batched(states, 40000)
         assert (np.asarray(b.proto["head"]) == ha).all()
+
+
+class TestByzVariants:
+    """Byzantine producer variants on the batched path (CasperIMD.java
+    :511-640): head-start delay, skip-father, skip-on-skip."""
+
+    def _oracle(self, variant, delay, run_ms=RUN_MS):
+        from wittgenstein_tpu.protocols.casper import (
+            ByzBlockProducer,
+            ByzBlockProducerNS,
+            ByzBlockProducerSF,
+        )
+
+        cls = {
+            "delay": ByzBlockProducer,
+            "sf": ByzBlockProducerSF,
+            "ns": ByzBlockProducerNS,
+        }[variant]
+        Block.reset_block_ids()
+        o = CasperIMD(CasperParameters())
+        o.network().rd.set_seed(0)
+        o.init(cls(o, delay, o.genesis))
+        o.network().run_ms(run_ms)
+        heights = np.array([n.head.height for n in o.network().all_nodes])
+        msgs = sum(n.msg_received for n in o.network().all_nodes)
+        return o, heights, msgs
+
+    def test_delay_variant_oracle_parity(self):
+        """Head-start producer with 3 s delay: same chain advance, same
+        traffic, same direct/older-father accounting as the oracle."""
+        o, oh, om = self._oracle("delay", 3000)
+        net, state = make_casper(
+            CasperParameters(), max_heights=16, byz_variant="delay", byz_delay=3000
+        )
+        out = net.run_ms(state, RUN_MS)
+        bh = np.asarray(out.proto["head"])
+        assert abs(int(bh.max()) - int(oh.max())) <= 1
+        assert int(np.asarray(out.msg_received).sum()) == om
+        bp0 = o.bps[0]
+        b0 = int(np.asarray(out.proto["byz_direct"]).max())
+        b1 = int(np.asarray(out.proto["byz_older"]).max())
+        assert (b0, b1) == (bp0.on_direct_father, bp0.on_older_ancestor)
+
+    def test_sf_variant_skips_father(self):
+        """Skip-father producer: its blocks build on height-2 ancestors
+        (stealing the father's transactions), matching the oracle's
+        skip accounting."""
+        o, oh, om = self._oracle("sf", 0)
+        net, state = make_casper(
+            CasperParameters(), max_heights=16, byz_variant="sf", byz_delay=0
+        )
+        out = net.run_ms(state, RUN_MS)
+        parent = np.asarray(out.proto["blk_parent"])
+        exists = np.asarray(out.proto["blk_exists"])
+        bpc = CasperParameters().block_producers_count
+        # bp0 owns heights 1, 1+bpc, ... — skipped parents show h-2
+        skips = [
+            h
+            for h in range(1 + bpc, int(exists.sum()) - 1, bpc)
+            if exists[h] and parent[h] == h - 2
+        ]
+        bp0 = o.bps[0]
+        assert int(np.asarray(out.proto["byz_direct"]).max()) == bp0.on_direct_father
+        assert len(skips) > 0 or bp0.on_direct_father == 0
+
+    def test_ns_variant_oracle_parity(self):
+        o, oh, om = self._oracle("ns", 0)
+        net, state = make_casper(
+            CasperParameters(), max_heights=16, byz_variant="ns", byz_delay=0
+        )
+        out = net.run_ms(state, RUN_MS)
+        bh = np.asarray(out.proto["head"])
+        assert abs(int(bh.max()) - int(oh.max())) <= 1
+        bp0 = o.bps[0]
+        assert int(np.asarray(out.proto["byz_skipped"]).max()) == bp0.skipped

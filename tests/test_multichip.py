@@ -106,3 +106,42 @@ class TestNodeSharding:
         mesh = _mesh("nodes")
         with pytest.raises(Exception):
             pingpong_progression(100, [100], mesh=mesh)  # 100 % 8 != 0
+
+
+class TestNodeShardedEngine:
+    def test_run_ms_node_sharded_bit_identical(self):
+        """VERDICT r3 item 6: the REAL engine (batched Handel run_ms), one
+        replica, node columns + channel/candidate buffers sharded over the
+        8-device mesh via NamedSharding — bit-identical to the unsharded
+        run, and the node-axis sharding survives to the outputs."""
+        from jax.sharding import NamedSharding
+        from wittgenstein_tpu.parallel import (
+            run_ms_node_sharded,
+            shard_state_by_node,
+        )
+
+        p = HandelParameters(
+            node_count=64,
+            threshold=60,
+            pairing_time=3,
+            level_wait_time=20,
+            extra_cycle=5,
+            dissemination_period_ms=10,
+            fast_path=10,
+            nodes_down=0,
+        )
+        net, state = make_handel(p)
+        ref = net.run_ms(state, 400)
+
+        mesh = _mesh("nodes")
+        sharded_in = shard_state_by_node(net, state, mesh)
+        assert sharded_in.done_at.sharding == NamedSharding(mesh, P("nodes"))
+        out = run_ms_node_sharded(net, sharded_in, 400)
+
+        assert (np.asarray(out.done_at) == np.asarray(ref.done_at)).all()
+        assert (np.asarray(out.msg_received) == np.asarray(ref.msg_received)).all()
+        for key in ("inc", "in_key", "cand_rank", "window", "sigs_checked"):
+            assert (
+                np.asarray(out.proto[key]) == np.asarray(ref.proto[key])
+            ).all(), key
+        assert int(out.proto["displaced"]) == int(ref.proto["displaced"])

@@ -1,0 +1,159 @@
+"""Parity at (near) north-star scale — BASELINE sweep configs #2/#3/#4.
+
+The default suite proves CDF parity at 64 nodes; these tests prove the
+batched engine's approximations (rank hashing, simultaneous same-ms
+delivery, channel displacement) do NOT drift as N grows:
+
+  * Handel 1024: P10/P50/P90 of time-to-threshold vs the oracle DES
+  * GSF 2048: P10/P50/P90 of time-to-threshold vs the oracle DES
+  * CasperIMD 1024 validators: latency-model sweep, chain shape + head
+    height + exact traffic vs the oracle
+
+All are `slow` (minutes each, oracle-side): run with `-m slow`.  The
+default `-m "not slow"` run keeps the suite under the iteration-speed
+budget (VERDICT r3 item 9).
+"""
+
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.core.registries import builder_name
+from wittgenstein_tpu.engine import replicate_state
+
+NL = "NetworkLatencyByDistanceWJitter"
+NB = builder_name("RANDOM", True, 0)
+
+pytestmark = pytest.mark.slow
+
+
+class TestHandel1024:
+    def test_oracle_quantile_parity(self):
+        from wittgenstein_tpu.protocols.handel import HandelParameters
+
+        from test_handel_batched import batched_done_at, oracle_done_at
+
+        n = 1024
+        p = HandelParameters(
+            node_count=n,
+            threshold=int(n * 0.99),
+            pairing_time=3,
+            level_wait_time=20,
+            dissemination_period_ms=10,
+            fast_path=10,
+            nodes_down=0,
+            node_builder_name=NB,
+            network_latency_name=NL,
+        )
+        o = oracle_done_at(p, range(3), 2500)
+        assert (o > 0).all()
+        b = batched_done_at(p, 4, 2500)
+        assert (b > 0).all()
+        oq = np.percentile(o, [10, 50, 90])
+        bq = np.percentile(b, [10, 50, 90])
+        rel = np.abs(bq - oq) / oq
+        assert (rel <= 0.08).all(), (oq, bq, rel)
+
+    def test_displacement_measured_harmless(self):
+        """Channel displacement is visible (proto['displaced']) and stays a
+        bounded fraction of traffic at scale; parity above proves the rate
+        harmless — this pins the rate so a regression (e.g. a config whose
+        fan-in overwhelms the D=8 slots) fails loudly."""
+        from wittgenstein_tpu.protocols.handel import HandelParameters
+        from wittgenstein_tpu.protocols.handel_batched import make_handel
+
+        n = 1024
+        p = HandelParameters(
+            node_count=n,
+            threshold=int(n * 0.99),
+            pairing_time=3,
+            level_wait_time=20,
+            dissemination_period_ms=10,
+            fast_path=10,
+            nodes_down=0,
+            node_builder_name=NB,
+            network_latency_name=NL,
+        )
+        net, state = make_handel(p)
+        state = net.run_ms(state, 2500)
+        assert (np.asarray(state.done_at) > 0).all()
+        displaced = int(state.proto["displaced"])
+        received = int(np.asarray(state.msg_received).sum())
+        assert displaced > 0  # the counter is live
+        assert displaced <= 0.45 * received, (displaced, received)
+
+
+class TestGSF2048:
+    def test_oracle_quantile_parity(self):
+        from wittgenstein_tpu.protocols.gsf import GSFSignature, GSFSignatureParameters
+        from wittgenstein_tpu.protocols.gsf_batched import make_gsf
+
+        n = 2048
+        p = GSFSignatureParameters(
+            node_count=n,
+            threshold=int(n * 0.99),
+            pairing_time=3,
+            timeout_per_level_ms=50,
+            period_duration_ms=10,
+            accelerated_calls_count=10,
+            nodes_down=0,
+            node_builder_name=NB,
+            network_latency_name=NL,
+        )
+        o = []
+        for seed in range(2):
+            proto = GSFSignature(p)
+            proto.network().rd.set_seed(seed)
+            proto.init()
+            proto.network().run_ms(3000)
+            o += [nd.done_at for nd in proto.network().live_nodes()]
+        o = np.asarray(o)
+        assert (o > 0).all()
+
+        net, state = make_gsf(p)
+        states = replicate_state(state, 4)
+        out = net.run_ms_batched(states, 3000)
+        b = np.asarray(out.done_at)[~np.asarray(out.down)]
+        assert (b > 0).all()
+        oq = np.percentile(o, [10, 50, 90])
+        bq = np.percentile(b, [10, 50, 90])
+        rel = np.abs(bq - oq) / oq
+        assert (rel <= 0.08).all(), (oq, bq, rel)
+
+
+class TestCasper1024:
+    @pytest.mark.parametrize(
+        "latency",
+        [
+            "NetworkLatencyByDistanceWJitter",
+            "NetworkLatencyAwsRegionNetwork",
+            "NetworkLatencyIFB",
+        ],
+    )
+    def test_latency_model_sweep_parity(self, latency):
+        """BASELINE config #4: 1024 validators (256 attesters x 4 rounds),
+        per latency model: same linear chain, same head height +-1 slot,
+        exact same total traffic as the oracle."""
+        from wittgenstein_tpu.protocols.casper import CasperParameters
+        from wittgenstein_tpu.protocols.casper_batched import make_casper
+
+        from test_casper_batched import oracle_run
+
+        p = CasperParameters(
+            cycle_length=4,
+            attesters_per_round=256,
+            network_latency_name=latency,
+        )
+        run_ms = 48000  # 6 slots
+        _, oh, om = oracle_run(p, run_ms=run_ms)
+        net, state = make_casper(p, max_heights=12)
+        out = net.run_ms(state, run_ms)
+        bh = np.asarray(out.proto["head"])
+        parent = np.asarray(out.proto["blk_parent"])
+        exists = np.asarray(out.proto["blk_exists"])
+        n_blocks = int(exists.sum()) - 1
+        assert n_blocks >= 4
+        for h in range(1, n_blocks + 1):
+            assert parent[h] == h - 1
+        assert abs(int(bh.max()) - int(oh.max())) <= 1
+        assert int(np.asarray(out.msg_received).sum()) == om
+        assert int(out.dropped) == 0

@@ -81,3 +81,26 @@ class TestOracleScenarioSuites:
         assert out.stat().st_size > 10_000
         # 3 series per run (min/max/avg) x 2 runs
         assert len(g.series) == 6
+
+
+class TestGenAnim:
+    def test_gen_anim_writes_gif(self, tmp_path):
+        """genAnim (HandelScenarios.java:291 / Handel.drawImgs :700-768):
+        a Handel run rendered through NodeDrawer to an animated GIF."""
+        from PIL import Image
+
+        from wittgenstein_tpu.scenarios.handel_scenarios import gen_anim
+
+        dest = str(tmp_path / "handel.gif")
+        out = gen_anim(nodes=32, sim_ms=200, frequency_ms=20, dest=dest)
+        img = Image.open(out)
+        assert img.format == "GIF"
+        img.seek(0)
+        frames = 1
+        try:
+            while True:
+                img.seek(img.tell() + 1)
+                frames += 1
+        except EOFError:
+            pass
+        assert frames == 200 // 20

@@ -245,21 +245,28 @@ class BatchedNetwork:
                 state, mask, from_idx, to_idx, send_time, mtype
             )
 
-        # pack the ok-messages into ring slots [head, head+n_ok) (mod C)
+        # pack the ok-messages into FREE ring slots: the k-th ok row takes
+        # the k-th invalid slot.  (A head cursor would clobber still-pending
+        # long-lived messages — ENR's birth/exit wakes, scheduled tasks —
+        # as soon as cumulative traffic wraps the capacity, even with most
+        # slots free.)  Only a genuinely full ring drops, and it drops the
+        # NEW rows, counted in `dropped`.
+        free = ~state.msg_valid  # [C]
+        free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+        slot_of_rank = jnp.full(self.capacity + 1, self.capacity, jnp.int32)
+        slot_of_rank = slot_of_rank.at[
+            jnp.where(free, free_rank, self.capacity)
+        ].set(jnp.arange(self.capacity, dtype=jnp.int32), mode="drop")
+        n_free = jnp.sum(free.astype(jnp.int32))
         slot_rank = jnp.cumsum(ok.astype(jnp.int32)) - 1
-        pos = lax.rem(state.msg_head + slot_rank, jnp.int32(self.capacity))
-        pos = jnp.where(ok, pos, jnp.int32(self.capacity))  # OOB -> dropped
+        fits = ok & (slot_rank < n_free)
+        pos = jnp.where(
+            fits,
+            slot_of_rank[jnp.clip(slot_rank, 0, self.capacity)],
+            jnp.int32(self.capacity),  # OOB -> dropped
+        )
         n_ok = jnp.sum(ok.astype(jnp.int32))
-        overwritten = jnp.sum(
-            (state.msg_valid.at[pos].get(mode="fill", fill_value=False) & ok).astype(
-                jnp.int32
-            )
-        )
-        # overflow accounting: slots overwritten while still valid, plus
-        # intra-emission slot collisions when one emission exceeds capacity
-        overwritten = overwritten + jnp.maximum(
-            0, n_ok - jnp.int32(self.capacity)
-        )
+        overwritten = jnp.sum((ok & ~fits).astype(jnp.int32))
         payload = em.payload
         if self.payload_width and payload is None:
             payload = jnp.zeros((k, self.payload_width), dtype=jnp.int32)
@@ -271,7 +278,9 @@ class BatchedNetwork:
             msg_type=state.msg_type.at[pos].set(
                 jnp.broadcast_to(mtype, (k,)), mode="drop"
             ),
-            msg_head=lax.rem(state.msg_head + n_ok, jnp.int32(self.capacity)),
+            # head is no longer an allocator (free-slot packing above); kept
+            # as a monotone sent-message counter for observability
+            msg_head=state.msg_head + n_ok,
             dropped=state.dropped + overwritten,
         )
         if self.payload_width:

@@ -8,15 +8,23 @@ one Java thread plus sequential reseeded runs (RunMultipleTimes.java:
   * replica axis — independent simulations sharded over a
     `jax.sharding.Mesh` with NamedSharding; XLA inserts the collectives
     for cross-device statistics (replica_shard).
-  * node axis — the SoA node state of ONE huge simulation sharded with
-    `shard_map`, communicating through explicit collectives (psum /
-    all_gather) over the mesh axis (node_shard: the working spike).
+  * node axis — the SoA node state of ONE huge simulation sharded over
+    the mesh: the real engine's run_ms under XLA's SPMD partitioner
+    (node_shard.shard_state_by_node / run_ms_node_sharded), plus a
+    fully-explicit shard_map + psum spike of the same pattern
+    (node_shard.pingpong_progression).
 
 Both run identically on a virtual CPU mesh
 (--xla_force_host_platform_device_count), a TPU pod slice (ICI), or
 multi-host (DCN) — the mesh is the only thing that changes.
 """
 
+from .node_shard import run_ms_node_sharded, shard_state_by_node
 from .replica_shard import shard_replicas, sharded_run_stats
 
-__all__ = ["shard_replicas", "sharded_run_stats"]
+__all__ = [
+    "run_ms_node_sharded",
+    "shard_state_by_node",
+    "shard_replicas",
+    "sharded_run_stats",
+]

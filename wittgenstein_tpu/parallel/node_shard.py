@@ -1,21 +1,35 @@
-"""Node-axis sharding spike: one simulation's node state split across
-devices with `shard_map` + explicit collectives.
+"""Node-axis sharding: one simulation's node state split across devices.
 
 The replica axis (replica_shard) scales the number of simulations; this
 axis scales ONE simulation past a single device's memory — the analog of
-the sequence/context parallelism axis in ML workloads (SURVEY §5).  The
-spike shards the PingPong broadcast/reply pattern: each device owns a
-block of node columns, computes its block's ping and pong arrival times
-with the real latency models and the engine's counter RNG, and the
-witness's pong progression is a `psum` over the mesh axis.  The sharded
-result is bit-identical to the unsharded computation (the CI test), on a
-virtual CPU mesh or real chips alike.
+the sequence/context parallelism axis in ML workloads (SURVEY §5).
 
-What this proves for the full engine: static node columns shard cleanly;
-latency kernels are local given the peer row (here the witness row is
-replicated — for general protocols the peer rows travel via
-all_gather/all_to_all, which is the next step flagged in SURVEY §7);
-statistics reduce with one collective.
+Two layers:
+
+1. **The real engine, GSPMD-partitioned** (`shard_state_by_node` +
+   `run_ms_node_sharded`): every mutable per-node array of a batched
+   simulation state — node columns, the aggregation protocols' channel
+   and candidate buffers, counters — is annotated with a NamedSharding
+   over the mesh's node axis, and the engine's existing `run_ms` program
+   runs under XLA's SPMD partitioner, which inserts the peer-exchange
+   collectives the cross-node scatters need (the scaling-book recipe:
+   pick a mesh, annotate shardings, let XLA place collectives).  The
+   result is bit-identical to the unsharded run — everything in the tick
+   is integer or elementwise-float math, so partitioning cannot reorder
+   a reduction.  Known limit, documented honestly: for scatter/gather
+   ops with computed indices (the send path) XLA may choose to
+   all-gather operands rather than all_to_all the update rows, so the
+   per-device MEMORY win applies to the compute-heavy phases
+   (candidate merge, scoring, commit) before it applies to the channel
+   arrays; replacing those with explicit shard_map all_to_all exchange
+   is the flagged next step (SURVEY §7).
+
+2. **The shard_map spike** (`pingpong_progression`): the PingPong
+   broadcast/reply pattern with explicit collectives — each device owns
+   a block of node columns, computes its block's arrivals with the real
+   latency models and counter RNG, and the witness's progression is a
+   `psum` over the mesh axis.  Kept as the minimal, fully-explicit
+   reference of the pattern.
 """
 
 from __future__ import annotations
@@ -26,7 +40,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # jax >= 0.8
     from jax import shard_map
@@ -38,6 +52,30 @@ from ..core.node import Node, build_node_columns
 from ..core.registries import registry_network_latencies, registry_node_builders
 from ..engine.rng import hash32, pseudo_delta
 from ..utils.javarand import JavaRandom
+
+
+def shard_state_by_node(net, state, mesh: Mesh, axis: str = "nodes"):
+    """Place ONE simulation's state onto the mesh with every [N, ...]
+    array (leading dim == n_nodes) sharded over `axis` and everything
+    else (scalars, the message ring, static tables) replicated."""
+    n = net.n_nodes
+    row_sharding = NamedSharding(mesh, P(axis))
+    rep_sharding = NamedSharding(mesh, P())
+
+    def put(a):
+        a = jnp.asarray(a)
+        if a.ndim >= 1 and a.shape[0] == n:
+            return jax.device_put(a, row_sharding)
+        return jax.device_put(a, rep_sharding)
+
+    return jax.tree_util.tree_map(put, state)
+
+
+def run_ms_node_sharded(net, state, ms: int):
+    """Advance a node-sharded simulation `ms` milliseconds: the engine's
+    own compiled program, partitioned by XLA over the state's shardings.
+    Call with the output of shard_state_by_node."""
+    return net.run_ms(state, ms)
 
 
 def _build_population(node_ct: int, node_builder_name, network_latency_name):

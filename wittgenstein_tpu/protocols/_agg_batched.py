@@ -12,9 +12,22 @@ slots (earliest arrival wins; slot = arrival mod D) plus one freshest-
 offer backstop slot that is always overwritten by the newest send — so
 when a level's traffic dies out, the last content a laggard was offered
 still delivers instead of being displaced.  Content is stored in SENDER
-bit space at the level's exact word width w_l = max(1, 2^(l-1)/32),
-packed into one flat word axis (W_total = sum w_l) to dodge XLA's (8,128)
-tile padding on small minor dimensions.
+bit space.  Displacements (an ok send that wins neither slot, or evicts
+a still-pending occupant) are counted in proto["displaced"] — the
+channel analog of SimState.dropped.
+
+Program-size design (the r4 rewrite): levels are grouped into WIDTH
+BUCKETS — consecutive levels whose word width w_l = max(1, 2^(l-1)/32)
+falls in the same class {1}, {2,4}, {8,16}, {32,64}, ... — and every
+per-level computation runs once per BUCKET on a stacked [N, nl, ...]
+level axis (w padded to the bucket max) instead of once per level.
+Per-bucket channel/candidate content lives in flat 2D arrays
+[N, nl*slots*w_pad] (large minor dims dodge XLA's (8,128) tile padding),
+and block views of the full-width state vectors are pure
+reshape/concat/shift pipelines — no gathers or scatters.  At 4096 nodes
+this turns ~12 unrolled per-level bodies x 4 phases (plus ~24 per-level
+send calls at ~700 StableHLO lines each) into ~4 bucket bodies and 2
+stacked sends, which is what lets the flagship config compile.
 
 Keys pack ((arrival - now) << rel_bits) | rel and are decremented once
 per tick, so the packing never overflows int32 for node counts up to
@@ -23,15 +36,44 @@ MAX_NODES = 2^14; construction fails loudly beyond that.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
 from ..engine import BatchedProtocol
-from ..ops.bitops import level_block_mask, popcount_words
+from ..ops.bitops import popcount_words, xor_shuffle
 
 INT32_MAX = np.int32(2**31 - 1)
 MAX_NODES = 1 << 14  # int32 key-packing headroom
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """A run of consecutive levels sharing one padded word width."""
+
+    levels: tuple  # level numbers, ascending
+    w_pad: int  # padded width (max exact width in the bucket)
+
+    @property
+    def lo(self) -> int:
+        return self.levels[0]
+
+    @property
+    def hi(self) -> int:
+        return self.levels[-1]
+
+    @property
+    def nl(self) -> int:
+        return len(self.levels)
+
+
+def _width_class(w: int) -> int:
+    """Bucket class of a level width: {1} {2,4} {8,16} {32,64} ..."""
+    if w == 1:
+        return 0
+    return (w.bit_length() + 1) // 2
 
 
 class BitsetAggBase(BatchedProtocol):
@@ -54,54 +96,114 @@ class BitsetAggBase(BatchedProtocol):
         self.MSG_TYPES = [f"SIGS_L{l}" for l in range(self.n_levels)]
 
         # per-level content geometry: level l's payload is bits [0, 2^(l-1))
-        # = w_l words at flat offset off_l
+        # = w_l exact words; bs_l = block size in bits
         self.w = [0] * self.n_levels
-        self.off = [0] * self.n_levels
-        acc = 0
+        self.bs = [0] * self.n_levels
         for l in range(1, self.n_levels):
+            self.bs[l] = 1 << (l - 1)
             self.w[l] = max(1, (1 << (l - 1)) // 32)
-            self.off[l] = acc
-            acc += self.w[l]
-        self.w_total = acc
         self.w_max = self.w[self.n_levels - 1] if self.n_levels > 1 else 1
 
-        # static full-width level masks (receiver rel space)
-        self.level_masks = np.stack(
-            [level_block_mask(l, self.n_words) for l in range(self.n_levels)]
+        # width buckets over levels 1..L-1
+        buckets = []
+        for l in range(1, self.n_levels):
+            cls = _width_class(self.w[l])
+            if buckets and _width_class(buckets[-1][1]) == cls:
+                buckets[-1][0].append(l)
+                buckets[-1][1] = max(buckets[-1][1], self.w[l])
+            else:
+                buckets.append([[l], self.w[l]])
+        self.buckets = [Bucket(tuple(lv), wp) for lv, wp in buckets]
+
+        # static per-level tables (stacked [L-1] vectors, level-1 at index 0)
+        self.lv_w = np.asarray(self.w[1:], np.int32)  # exact widths
+        self.lv_bs = np.asarray(self.bs[1:], np.int32)  # block sizes
+
+    # -- stacked block views -------------------------------------------------
+    # Full-width [.., W] layout is the concatenation of level blocks:
+    # word 0 = bit 0 (level 0) + sub-word blocks of levels with bs < 32;
+    # each level with bs >= 32 owns words [bs/32, 2bs/32).
+
+    def _blocks(self, x, b: Bucket):
+        """Bucket view of full-width vectors: [N, W] -> [N, nl, w_pad],
+        zero above each level's exact width."""
+        outs = []
+        for l in b.levels:
+            bs, w = self.bs[l], self.w[l]
+            if bs < 32:
+                blk = (x[..., 0:1] >> jnp.uint32(bs)) & jnp.uint32((1 << bs) - 1)
+            else:
+                blk = x[..., bs // 32 : (2 * bs) // 32]
+            if w < b.w_pad:
+                blk = jnp.concatenate(
+                    [blk, jnp.zeros(blk.shape[:-1] + (b.w_pad - w,), jnp.uint32)],
+                    axis=-1,
+                )
+            outs.append(blk)
+        return jnp.stack(outs, axis=-2)  # [.., nl, w_pad]
+
+    def _lows(self, x, b: Bucket):
+        """Bucket view of sender-space outgoing content (bits [0, 2^(l-1)))
+        per level: [N, W] -> [N, nl, w_pad], zero-padded."""
+        outs = []
+        for l in b.levels:
+            bs, w = self.bs[l], self.w[l]
+            if bs < 32:
+                blk = x[..., 0:1] & jnp.uint32((1 << bs) - 1)
+            else:
+                blk = x[..., : bs // 32]
+            if w < b.w_pad:
+                blk = jnp.concatenate(
+                    [blk, jnp.zeros(blk.shape[:-1] + (b.w_pad - w,), jnp.uint32)],
+                    axis=-1,
+                )
+            outs.append(blk)
+        return jnp.stack(outs, axis=-2)
+
+    def _assemble(self, x_old, pieces):
+        """Rebuild full-width vectors from per-bucket block stacks.
+
+        pieces: list aligned with self.buckets of [N, nl, w_pad] (zero above
+        exact widths).  Level-0's bit 0 is preserved from x_old."""
+        word0 = x_old[..., 0] & jnp.uint32(1)
+        tail = []
+        for b, pc in zip(self.buckets, pieces):
+            for j, l in enumerate(b.levels):
+                bs, w = self.bs[l], self.w[l]
+                blk = pc[..., j, :w]
+                if bs < 32:
+                    word0 = word0 | (blk[..., 0] << jnp.uint32(bs))
+                else:
+                    tail.append(blk)
+        return jnp.concatenate([word0[..., None]] + tail, axis=-1)
+
+    def _level_stats(self, per_bucket):
+        """Concat per-bucket [N, nl] level-axis stats into [N, L-1]."""
+        return jnp.concatenate(per_bucket, axis=-1)
+
+    def _width_mask(self, b: Bucket):
+        """bool[nl, w_pad]: word j valid for the bucket's level row."""
+        return (
+            np.arange(b.w_pad, dtype=np.int32)[None, :]
+            < np.asarray([self.w[l] for l in b.levels], np.int32)[:, None]
         )
-        low = np.zeros_like(self.level_masks)
-        acc_m = np.zeros(self.n_words, dtype=np.uint32)
-        for l in range(self.n_levels):
-            low[l] = acc_m  # bits below level l's block
-            acc_m = acc_m | self.level_masks[l]
-        self.low_masks = low
 
-    # -- block-local helpers -------------------------------------------------
-    # receiver rel space block [2^(l-1), 2^l) <-> block-local bits [0, 2^(l-1))
-    def _blk(self, x, l: int):
-        """Level-l block of full-width vectors [..., W] -> [..., w_l]."""
-        bs = 1 << (l - 1)
-        if bs >= 32:
-            return x[..., bs // 32 : (2 * bs) // 32]
-        return (x[..., 0:1] >> jnp.uint32(bs)) & jnp.uint32((1 << bs) - 1)
+    def _dyn_low(self, x, level, b: Bucket):
+        """Sender-space outgoing content at a DYNAMIC per-node level
+        (valid where level is inside bucket b): [N, W], [N] -> [N, w_pad]."""
+        lv = jnp.clip(level, 1, self.n_levels - 1) - 1
+        bs = jnp.asarray(self.lv_bs)[lv]
+        w = jnp.asarray(self.lv_w)[lv]
+        out = x[..., : b.w_pad]
+        if b.w_pad == 1 and self.bs[b.lo] < 32:
+            # sub-word levels: bits [0, bs) of word 0 (bs may be 32; the
+            # bs & 31 shift puts 0 in the lane the `full` select discards)
+            m = (jnp.uint32(1) << (bs & 31).astype(jnp.uint32)) - 1
+            m = jnp.where(bs >= 32, jnp.uint32(0xFFFFFFFF), m)
+            return out & m[..., None]
+        return out * (jnp.arange(b.w_pad, dtype=jnp.int32)[None, :] < w[..., None])
 
-    def _blk_write(self, x, l: int, blk, where):
-        """Write block-local [..., w_l] back into full-width [..., W]."""
-        bs = 1 << (l - 1)
-        if bs >= 32:
-            new = jnp.where(where[..., None], blk, x[..., bs // 32 : (2 * bs) // 32])
-            return x.at[..., bs // 32 : (2 * bs) // 32].set(new)
-        m = jnp.uint32(((1 << bs) - 1) << bs)
-        w0 = (x[..., 0] & ~m) | ((blk[..., 0] << jnp.uint32(bs)) & m)
-        return x.at[..., 0].set(jnp.where(where, w0, x[..., 0]))
-
-    def _low(self, x, l: int):
-        """Sender-space outgoing content at level l: bits [0, 2^(l-1))."""
-        bs = 1 << (l - 1)
-        if bs >= 32:
-            return x[..., : bs // 32]
-        return x[..., 0:1] & jnp.uint32((1 << bs) - 1)
-
+    # -- misc bit helpers (unchanged semantics) ------------------------------
     @staticmethod
     def _onehot(r0, w: int):
         """Block-local one-hot bit r0: [...] int32 -> [..., w] uint32."""
@@ -115,12 +217,12 @@ class BitsetAggBase(BatchedProtocol):
 
     @staticmethod
     def _lowest_bit(words):
-        """Index of the lowest set bit of packed [N, w] uint32 vectors
-        (undefined when empty — gate on popcount > 0)."""
+        """Index of the lowest set bit over the last axis of packed [..., w]
+        uint32 vectors (undefined when empty — gate on popcount > 0)."""
         word_nz = words != 0
-        widx = jnp.argmax(word_nz, axis=1).astype(jnp.int32)
-        wval = jnp.take_along_axis(words, widx[:, None], axis=1)[:, 0]
-        lowbit = popcount_words(((wval & (-wval).astype(jnp.uint32)) - 1)[:, None])
+        widx = jnp.argmax(word_nz, axis=-1).astype(jnp.int32)
+        wval = jnp.take_along_axis(words, widx[..., None], axis=-1)[..., 0]
+        lowbit = popcount_words(((wval & (-wval).astype(jnp.uint32)) - 1)[..., None])
         return widx * 32 + lowbit
 
     def _getbit(self, x, pos):
@@ -131,6 +233,10 @@ class BitsetAggBase(BatchedProtocol):
         return (word >> (pos & 31).astype(jnp.uint32)) & jnp.uint32(1)
 
     # -- channel layout ------------------------------------------------------
+    # in_key: [N, (L-1)*(D+1)] packed ((arrival-now)<<rel_bits | rel);
+    # content per bucket i: proto[f"in_sig{i}"] = [N, nl*(D+1)*w_pad] flat,
+    # level-major then slot then word.
+
     def _fresh_cols(self) -> np.ndarray:
         """bool[(L-1)*(D+1)]: which in_key columns are fresh-backstop slots."""
         ss = self.CHANNEL_DEPTH + 1
@@ -142,19 +248,29 @@ class BitsetAggBase(BatchedProtocol):
         ss = self.CHANNEL_DEPTH + 1
         return in_key[:, (l - 1) * ss : l * ss]
 
-    def _sig_seg(self, sig_flat, l: int, slots: int):
-        n = sig_flat.shape[0]
-        o, w = self.off[l] * slots, self.w[l] * slots
-        return sig_flat[:, o : o + w].reshape(n, slots, self.w[l])
+    def _keys_stacked(self, in_key):
+        """[N, (L-1)*ss] -> [N, L-1, ss]."""
+        ss = self.CHANNEL_DEPTH + 1
+        return in_key.reshape(in_key.shape[0], self.n_levels - 1, ss)
+
+    def _sig_view(self, proto, i: int, slots: int, prefix: str = "in_sig"):
+        """Bucket i's content as [N, nl, slots, w_pad]."""
+        b = self.buckets[i]
+        a = proto[f"{prefix}{i}"]
+        return a.reshape(a.shape[0], b.nl, slots, b.w_pad)
 
     def _channel_init(self, n: int):
-        """Fresh in_key / in_sig arrays (fresh slots empty at -1, arrival
-        slots at INT32_MAX)."""
-        d = self.CHANNEL_DEPTH
+        """Fresh in_key plus per-bucket in_sig arrays (fresh slots empty at
+        -1, arrival slots at INT32_MAX)."""
+        ss = self.CHANNEL_DEPTH + 1
         in_key = np.where(self._fresh_cols(), -1, INT32_MAX).astype(np.int32)
+        sigs = {
+            f"in_sig{i}": jnp.zeros((n, b.nl * ss * b.w_pad), jnp.uint32)
+            for i, b in enumerate(self.buckets)
+        }
         return (
             jnp.asarray(np.broadcast_to(in_key, (n, in_key.size)).copy()),
-            jnp.zeros((n, (d + 1) * self.w_total), jnp.uint32),
+            sigs,
         )
 
     def _advance_channel(self, in_key):
@@ -167,26 +283,36 @@ class BitsetAggBase(BatchedProtocol):
         )
         return in_key, due, empty_tpl
 
-    # -- send path -----------------------------------------------------------
-    def _send_level(self, net, state, l: int, mask, from_idx, to_idx, content, aux=None):
-        """Send K messages at level l into the per-(receiver, slot) channel;
-        earliest arrival wins an arrival slot, the newest offer always takes
-        the fresh slot.  Content is sender-space [K, w_l]; `aux` is an
-        optional [K] int32 side value stored per slot in proto["in_aux"]."""
+    # -- the stacked send path -----------------------------------------------
+    def _send_stacked(self, net, state, mask, from_idx, to_idx, level, content, aux=None):
+        """Send M messages (one per row, each at its own level) into the
+        per-(receiver, level, slot) channel in ONE body: earliest arrival
+        wins an arrival slot, the newest offer always takes the fresh slot.
+
+        mask/from_idx/to_idx/level: [M] (level in [1, L-1]); content: list
+        aligned with self.buckets of [M, w_pad] sender-space words (only
+        rows whose level lies in the bucket need valid values); aux:
+        optional [M] int32 stored per slot in proto["in_aux"].
+        """
         proto = state.proto
         d = self.CHANNEL_DEPTH
+        ss = d + 1
+        # masked rows may carry junk levels; clamp so every computed index
+        # is in range (their scatters are dropped via the n_nodes row)
+        level = jnp.clip(level.astype(jnp.int32), 1, self.n_levels - 1)
         state, ok, arrival = net.latency_arrivals(
-            state, mask, from_idx, to_idx, state.time + 1, jnp.int32(l)
+            state, mask, from_idx, to_idx, state.time + 1, level
         )
         # receiver traffic counters tick here, at send time: every ok send
         # is delivered by the oracle (Network.java:611-612), but the channel
         # may displace it — counting at send keeps end-of-run totals exact
         # at the cost of counters leading arrivals by the latency
         okc = ok.astype(jnp.int32)
+        sizes = jnp.asarray(self._size_table(), jnp.int32)[level]
         state = state._replace(
             msg_received=state.msg_received.at[to_idx].add(okc, mode="drop"),
             bytes_received=state.bytes_received.at[to_idx].add(
-                okc * self.msg_size(l), mode="drop"
+                okc * sizes, mode="drop"
             ),
         )
         rel = (to_idx ^ from_idx).astype(jnp.int32)
@@ -194,34 +320,45 @@ class BitsetAggBase(BatchedProtocol):
         # never overflows int32
         rel_arr = arrival - state.time
         key = jnp.where(ok, (rel_arr << self.rel_bits) | rel, INT32_MAX)
-        ss = d + 1
 
         slot = lax.rem(arrival, jnp.int32(d))
-        col = (l - 1) * ss + slot
+        col = (level - 1) * ss + slot
         safe_to = jnp.where(ok, to_idx, self.n_nodes)
+        prev = proto["in_key"].at[to_idx, col].get(mode="fill", fill_value=INT32_MAX)
         new_key = proto["in_key"].at[safe_to, col].min(key, mode="drop")
         winner = ok & (new_key[to_idx, col] == key)
 
         # freshest-offer backstop (empty at -1 so any real key wins the max)
-        fcol = (l - 1) * ss + d
+        fcol = (level - 1) * ss + d
         new_key = new_key.at[safe_to, fcol].max(jnp.where(ok, key, -1), mode="drop")
         fresh_win = ok & (new_key[to_idx, fcol] == key)
 
+        # displacement accounting (the channel's SimState.dropped analog):
+        # an ok send that won neither slot, or a winner that evicted a
+        # still-pending occupant with a later arrival
+        lost_entry = ok & ~winner & ~fresh_win
+        evicted = winner & (prev != INT32_MAX) & (prev > key)
+        displaced = jnp.sum((lost_entry | evicted).astype(jnp.int32))
+
+        updates = dict(proto, in_key=new_key, displaced=proto["displaced"] + displaced)
+
         win_to = jnp.where(winner, to_idx, self.n_nodes)
-        wcols = (ss * self.off[l] + slot[:, None] * self.w[l]) + jnp.arange(
-            self.w[l], dtype=jnp.int32
-        )
-        new_sig = proto["in_sig"].at[win_to[:, None], wcols].set(
-            content.astype(jnp.uint32), mode="drop"
-        )
         fwin_to = jnp.where(fresh_win, to_idx, self.n_nodes)
-        fwcols = (ss * self.off[l] + d * self.w[l]) + jnp.arange(
-            self.w[l], dtype=jnp.int32
-        )
-        new_sig = new_sig.at[fwin_to[:, None], fwcols[None, :]].set(
-            content.astype(jnp.uint32), mode="drop"
-        )
-        updates = dict(proto, in_key=new_key, in_sig=new_sig)
+        for i, b in enumerate(self.buckets):
+            in_b = (level >= b.lo) & (level <= b.hi)
+            li = level - b.lo  # level row inside the bucket
+            cw = jnp.arange(b.w_pad, dtype=jnp.int32)
+            cols = ((li * ss + slot) * b.w_pad)[:, None] + cw
+            fcols = ((li * ss + d) * b.w_pad)[:, None] + cw
+            cnt = content[i].astype(jnp.uint32)
+            a = updates[f"in_sig{i}"]
+            a = a.at[jnp.where(in_b, win_to, self.n_nodes)[:, None], cols].set(
+                cnt, mode="drop"
+            )
+            a = a.at[jnp.where(in_b, fwin_to, self.n_nodes)[:, None], fcols].set(
+                cnt, mode="drop"
+            )
+            updates[f"in_sig{i}"] = a
         if aux is not None:
             new_aux = proto["in_aux"].at[win_to, col].set(
                 aux.astype(jnp.int32), mode="drop"
@@ -229,3 +366,21 @@ class BitsetAggBase(BatchedProtocol):
             new_aux = new_aux.at[fwin_to, fcol].set(aux.astype(jnp.int32), mode="drop")
             updates["in_aux"] = new_aux
         return state._replace(proto=updates)
+
+    def _size_table(self):
+        return np.asarray(
+            [self.msg_size(t) for t in range(self.n_levels)], np.int32
+        )
+
+    # -- shared shuffle-and-merge helper -------------------------------------
+    def _arrived_blocks(self, proto, i: int, r0):
+        """Bucket i's in-flight content re-addressed into receiver
+        block-local space: [N, nl, ss, w_pad]; r0 is [N, nl, ss] (the
+        block-local xor; junk rows give junk output — mask with `due`)."""
+        ss = self.CHANNEL_DEPTH + 1
+        b = self.buckets[i]
+        sig = self._sig_view(proto, i, ss)
+        out = xor_shuffle(sig, r0)
+        # shuffle may smear content into the zero padding; re-mask
+        wm = jnp.asarray(self._width_mask(b))
+        return out * wm[None, :, None, :]

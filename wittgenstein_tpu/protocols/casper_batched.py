@@ -39,7 +39,15 @@ TPU-first design — everything is a HEIGHT:
 Approximations (documented): tie-breaks compare (proposal_time, height)
 instead of creation ids; `random_on_ties` uses the counter hash; the
 oracle's same-ms LIFO interleavings of task vs arrival are simultaneous.
-Byzantine variants other than the default WF producer are oracle-only.
+
+Byzantine producer variants (make_casper byz_variant/byz_delay): besides
+the default "wf" (ByzBlockProducerWF :647-707), the head-start producer
+"delay" (ByzBlockProducer :511-580 — fires delay ms into its slot and
+builds on the best ancestor below toSend), "sf" (ByzBlockProducerSF
+:583-604 — skips its direct father to steal its transactions), and "ns"
+(ByzBlockProducerNS :610-640 — skips its father when the father skipped
+the grandfather).  All run on the batched path, so Byzantine sweeps for
+the blockchain family are replica-parallel like Handel's.
 """
 
 from __future__ import annotations
@@ -59,11 +67,22 @@ from .casper import SLOT_DURATION, Attester, BlockProducer, CasperIMD, CasperPar
 
 
 class BatchedCasper(BatchedProtocol):
-    MSG_TYPES = ["BLOCK", "ATT", "TBP", "TATT", "TWF", "TWFB"]
+    MSG_TYPES = ["BLOCK", "ATT", "TBP", "TATT", "TWF", "TWFB", "TBYZ"]
     PAYLOAD_WIDTH = 2
     TICK_INTERVAL = None  # all timing is explicit-arrival self-messages
 
-    def __init__(self, params: CasperParameters, roles: dict, max_heights: int):
+    def __init__(
+        self,
+        params: CasperParameters,
+        roles: dict,
+        max_heights: int,
+        byz_variant: str = "wf",
+        byz_delay: int = 0,
+    ):
+        if byz_variant not in ("wf", "delay", "sf", "ns"):
+            raise ValueError(f"unknown byz_variant {byz_variant!r}")
+        self.byz_variant = byz_variant
+        self.byz_delay = byz_delay
         self.params = params
         self.mh = max_heights
         self.apr = params.attesters_per_round
@@ -108,10 +127,14 @@ class BatchedCasper(BatchedProtocol):
             "seen": seen,
             "rec_att": jnp.zeros((n, ma), bool),
             "reeval": jnp.zeros((n, mh), bool),
-            # ByzBlockProducerWF bookkeeping (row bp0 only, :647-707)
+            # ByzBlockProducer* bookkeeping (row bp0 only; :511-707):
+            # wf_to_send doubles as every variant's toSend cursor
             "wf_to_send": jnp.full(n, 1, jnp.int32),
             "wf_late": jnp.zeros(n, jnp.int32),
             "wf_on_time": jnp.zeros(n, jnp.int32),
+            "byz_direct": jnp.zeros(n, jnp.int32),  # onDirectFather
+            "byz_older": jnp.zeros(n, jnp.int32),  # onOlderAncestor
+            "byz_skipped": jnp.zeros(n, jnp.int32),  # NS skipped
         }
 
     # -- fork choice ---------------------------------------------------------
@@ -255,15 +278,28 @@ class BatchedCasper(BatchedProtocol):
         arr_bp = jnp.where(
             self.is_bp, SLOT_DURATION * (ids - self.bp0 + 1), 1
         ).astype(jnp.int32)
-        ems = [
-            Emission(  # WF producer kick-off tick
+        if self.byz_variant == "wf":
+            em0 = Emission(  # WF producer kick-off tick
                 mask=ids == self.bp0,
                 from_idx=ids,
                 to_idx=ids,
                 mtype=self.mtype("TWF"),
                 payload=jnp.zeros((n, 2), jnp.int32),
                 arrival=jnp.full(n, SLOT_DURATION, jnp.int32),
-            ),
+            )
+        else:
+            # delay/sf/ns: periodic at SLOT + delay, period SLOT*bpc
+            # (init registration, CasperIMD.java:486-492)
+            em0 = Emission(
+                mask=ids == self.bp0,
+                from_idx=ids,
+                to_idx=ids,
+                mtype=self.mtype("TBYZ"),
+                payload=jnp.zeros((n, 2), jnp.int32),
+                arrival=jnp.full(n, SLOT_DURATION + self.byz_delay, jnp.int32),
+            )
+        ems = [
+            em0,
             Emission(
                 mask=self.is_bp,
                 from_idx=ids,
@@ -338,45 +374,49 @@ class BatchedCasper(BatchedProtocol):
             state, proto, proto["rec_att"], proto["head"], best_new, got_blk
         )
 
-        # WF producer response (:660-676): fires when the awaited parent
-        # (toSend-1) is among THIS tick's new blocks — membership, not the
-        # max, so a same-tick higher block cannot mask it
-        want = jnp.clip(proto["wf_to_send"] - 1, 0, mh - 1)
-        wf_hit = (ids == self.bp0) & new_blk[ids, want]
-        th = proto["wf_to_send"]
-        perfect = SLOT_DURATION * th  # + delay (0 for the default init)
-        fire_now = wf_hit & (t >= perfect)
-        fire_later = wf_hit & ~fire_now
-        proto["wf_late"] = proto["wf_late"] + fire_now.astype(jnp.int32)
-        proto["wf_on_time"] = proto["wf_on_time"] + fire_later.astype(jnp.int32)
-        proto["wf_to_send"] = jnp.where(wf_hit, th + self.bpc, proto["wf_to_send"])
-        emissions.append(
-            Emission(  # the scheduled build (registerTask(r, perfectDate))
-                mask=wf_hit,
-                from_idx=ids,
-                to_idx=ids,
-                mtype=self.mtype("TWFB"),
-                payload=jnp.stack([want, th], axis=1),
-                arrival=jnp.maximum(perfect, t + 1).astype(jnp.int32),
+        if self.byz_variant == "wf":
+            # WF producer response (:660-676): fires when the awaited parent
+            # (toSend-1) is among THIS tick's new blocks — membership, not
+            # the max, so a same-tick higher block cannot mask it
+            want = jnp.clip(proto["wf_to_send"] - 1, 0, mh - 1)
+            wf_hit = (ids == self.bp0) & new_blk[ids, want]
+            th = proto["wf_to_send"]
+            perfect = SLOT_DURATION * th + self.byz_delay
+            fire_now = wf_hit & (t >= perfect)
+            fire_later = wf_hit & ~fire_now
+            proto["wf_late"] = proto["wf_late"] + fire_now.astype(jnp.int32)
+            proto["wf_on_time"] = proto["wf_on_time"] + fire_later.astype(jnp.int32)
+            proto["wf_to_send"] = jnp.where(wf_hit, th + self.bpc, proto["wf_to_send"])
+            emissions.append(
+                Emission(  # the scheduled build (registerTask(r, perfectDate))
+                    mask=wf_hit,
+                    from_idx=ids,
+                    to_idx=ids,
+                    mtype=self.mtype("TWFB"),
+                    payload=jnp.stack([want, th], axis=1),
+                    arrival=jnp.maximum(perfect, t + 1).astype(jnp.int32),
+                )
             )
-        )
 
-        # ---- 3. WF kick-off (periodic while nothing produced, :692-698) ---
-        twf = jnp.zeros(n, bool).at[to].max(is_twf, mode="drop")
-        wf_kick = twf & (proto["head"] == 0) & (proto["wf_to_send"] == 1)
-        proto["wf_to_send"] = jnp.where(wf_kick, 1 + self.bpc, proto["wf_to_send"])
-        emissions.append(
-            Emission(  # re-arm the kick-off watchdog
-                mask=twf,
-                from_idx=ids,
-                to_idx=ids,
-                mtype=self.mtype("TWF"),
-                payload=jnp.zeros((n, 2), jnp.int32),
-                arrival=jnp.broadcast_to(
-                    t + SLOT_DURATION * self.bpc, (n,)
-                ).astype(jnp.int32),
+            # ---- 3. WF kick-off (periodic while nothing produced, :692-698)
+            twf = jnp.zeros(n, bool).at[to].max(is_twf, mode="drop")
+            wf_kick = twf & (proto["head"] == 0) & (proto["wf_to_send"] == 1)
+            proto["wf_to_send"] = jnp.where(wf_kick, 1 + self.bpc, proto["wf_to_send"])
+            emissions.append(
+                Emission(  # re-arm the kick-off watchdog
+                    mask=twf,
+                    from_idx=ids,
+                    to_idx=ids,
+                    mtype=self.mtype("TWF"),
+                    payload=jnp.zeros((n, 2), jnp.int32),
+                    arrival=jnp.broadcast_to(
+                        t + SLOT_DURATION * self.bpc, (n,)
+                    ).astype(jnp.int32),
+                )
             )
-        )
+        else:
+            twf = jnp.zeros(n, bool)
+            wf_kick = jnp.zeros(n, bool)
 
         # ---- 4. honest producers fire (reevaluate + build, :365-381) ------
         tbp = jnp.zeros(n, bool).at[to].max(is_tbp, mode="drop")
@@ -408,8 +448,12 @@ class BatchedCasper(BatchedProtocol):
             )
         )
 
+        # byz head-start producers (delay/sf/ns) fire on their own beat
+        is_tbyz = m_("TBYZ")
+        tbyz = jnp.zeros(n, bool).at[to].max(is_tbyz, mode="drop")
+
         # one reevaluation pass for every node acting this tick
-        acting = tbp | tatt | twf
+        acting = tbp | tatt | twf | tbyz
         proto = self._reevaluate(state, proto, acting)
 
         # honest production: height = slot index (:370-377)
@@ -419,28 +463,87 @@ class BatchedCasper(BatchedProtocol):
         )
         emissions.append(em_b)
 
-        # WF kick-off build: block 1 on genesis (reevaluateH at genesis)
-        proto, em_k = self._build_blocks(
-            state,
-            proto,
-            wf_kick,
-            jnp.zeros(n, jnp.int32),
-            jnp.ones(n, jnp.int32),
-        )
-        emissions.append(em_k)
+        if self.byz_variant == "wf":
+            # WF kick-off build: block 1 on genesis (reevaluateH at genesis)
+            proto, em_k = self._build_blocks(
+                state,
+                proto,
+                wf_kick,
+                jnp.zeros(n, jnp.int32),
+                jnp.ones(n, jnp.int32),
+            )
+            emissions.append(em_k)
 
-        # ---- 6. WF scheduled build lands (r(), :663-668) ------------------
-        twfb = jnp.zeros(n, bool).at[to].max(is_twfb, mode="drop")
-        wf_base = jnp.zeros(n, jnp.int32).at[to].max(
-            jnp.where(is_twfb, pay0, 0), mode="drop"
-        )
-        wf_th = jnp.zeros(n, jnp.int32).at[to].max(
-            jnp.where(is_twfb, pay1, 0), mode="drop"
-        )
-        proto, em_w = self._build_blocks(
-            state, proto, twfb & (wf_th < mh), wf_base, wf_th
-        )
-        emissions.append(em_w)
+            # ---- 6. WF scheduled build lands (r(), :663-668) --------------
+            twfb = jnp.zeros(n, bool).at[to].max(is_twfb, mode="drop")
+            wf_base = jnp.zeros(n, jnp.int32).at[to].max(
+                jnp.where(is_twfb, pay0, 0), mode="drop"
+            )
+            wf_th = jnp.zeros(n, jnp.int32).at[to].max(
+                jnp.where(is_twfb, pay1, 0), mode="drop"
+            )
+            proto, em_w = self._build_blocks(
+                state, proto, twfb & (wf_th < mh), wf_base, wf_th
+            )
+            emissions.append(em_w)
+        else:
+            # ---- 6'. byz producer fires (reevaluateH + variant head tweak
+            # + build, CasperIMD.java:529-542 + :285-300/:318-327/:342-356)
+            th = proto["wf_to_send"]
+            hr2 = jnp.arange(mh, dtype=jnp.int32)
+            # deepest ancestor of head strictly below toSend (the
+            # while-head.height>=toSend parent walk)
+            head_oh2 = jax.nn.one_hot(proto["head"], mh, dtype=bool)
+            cand = (proto["anc"][proto["head"]] | head_oh2) & (
+                hr2[None, :] < th[:, None]
+            )
+            base = jnp.max(jnp.where(cand, hr2[None, :], 0), axis=1).astype(jnp.int32)
+            direct = base == th - 1
+            if self.byz_variant == "sf":
+                # skip the direct father to steal its transactions
+                skip = tbyz & (base != 0) & direct
+                base = jnp.where(
+                    skip, jnp.clip(proto["blk_parent"][base], 0, mh - 1), base
+                )
+                proto["byz_direct"] = proto["byz_direct"] + (tbyz & skip).astype(jnp.int32)
+                proto["byz_older"] = proto["byz_older"] + (tbyz & ~skip).astype(jnp.int32)
+            elif self.byz_variant == "ns":
+                # skip the father when the father skipped the grandfather
+                gp = jnp.clip(proto["blk_parent"][base], 0, mh - 1)
+                cond = (
+                    tbyz
+                    & (base != 0)
+                    & direct
+                    & (gp == th - 3)
+                    & proto["seen"][ids, jnp.clip(th - 2, 0, mh - 1)]
+                    & proto["blk_exists"][jnp.clip(th - 2, 0, mh - 1)]
+                )
+                base = jnp.where(cond, jnp.clip(th - 2, 0, mh - 1), base)
+                proto["byz_skipped"] = proto["byz_skipped"] + cond.astype(jnp.int32)
+            else:  # plain delay: counters only
+                proto["byz_direct"] = proto["byz_direct"] + (tbyz & direct).astype(
+                    jnp.int32
+                )
+                proto["byz_older"] = proto["byz_older"] + (tbyz & ~direct).astype(
+                    jnp.int32
+                )
+            proto, em_z = self._build_blocks(
+                state, proto, tbyz & (th < mh), base, th
+            )
+            emissions.append(em_z)
+            proto["wf_to_send"] = jnp.where(tbyz, th + self.bpc, proto["wf_to_send"])
+            emissions.append(
+                Emission(  # re-arm the byz beat
+                    mask=tbyz,
+                    from_idx=ids,
+                    to_idx=ids,
+                    mtype=self.mtype("TBYZ"),
+                    payload=jnp.zeros((n, 2), jnp.int32),
+                    arrival=jnp.broadcast_to(
+                        t + SLOT_DURATION * self.bpc, (n,)
+                    ).astype(jnp.int32),
+                )
+            )
 
         # attester votes: create the attestation and broadcast it ------------
         vote_h = slot_now
@@ -496,12 +599,29 @@ def make_casper(
     max_heights: int = 24,
     capacity: int = 1 << 14,
     seed: int = 0,
+    byz_variant: str = "wf",
+    byz_delay: int = 0,
 ):
-    """Host-side construction from the oracle's default init (observer +
-    ByzBlockProducerWF(0) + honest producers + attesters, same RNG)."""
+    """Host-side construction from the oracle's init (observer + the chosen
+    Byzantine producer variant + honest producers + attesters, same RNG).
+    byz_variant selects node 0's producer: "wf" (default,
+    ByzBlockProducerWF), "delay", "sf", "ns" (CasperIMD.java:511-707)."""
     params = params or CasperParameters()
     oracle = CasperIMD(params)
-    oracle.init()
+    from .casper import (
+        ByzBlockProducer,
+        ByzBlockProducerNS,
+        ByzBlockProducerSF,
+        ByzBlockProducerWF,
+    )
+
+    byz_cls = {
+        "wf": ByzBlockProducerWF,
+        "delay": ByzBlockProducer,
+        "sf": ByzBlockProducerSF,
+        "ns": ByzBlockProducerNS,
+    }[byz_variant]
+    oracle.init(byz_cls(oracle, byz_delay, oracle.genesis))
     nodes = oracle.network().all_nodes
     n = len(nodes)
     att_ids = np.array(
@@ -531,7 +651,7 @@ def make_casper(
     latency = registry_network_latencies.get_by_name(params.network_latency_name)
     city_index = getattr(latency, "city_index", None)
     cols = build_node_columns(nodes, city_index)
-    proto = BatchedCasper(params, roles, max_heights)
+    proto = BatchedCasper(params, roles, max_heights, byz_variant, byz_delay)
     net = BatchedNetwork(proto, latency, n, capacity=capacity)
     state = net.init_state(cols, seed=seed, proto=proto.proto_init(n))
     return net, state

@@ -1,0 +1,468 @@
+"""Batched ENRGossiping: node-record gossip with churn on the TPU engine.
+
+Re-expression of protocols/ENRGossiping.java (via the oracle port
+protocols/enr_gossiping.py) — the last protocol family to get a batched
+twin, because BOTH static axes of the engine mutate at runtime: the node
+set grows (a joiner every timeToLeave/8 ms, ENRGossiping.java:284-293)
+and the peer graph is surgical (addedValue / removeWorseIfPossible,
+:296-322, :417-438).  The batched design follows
+docs/enr_batched_design.md:
+
+  * **Preallocated slots**: M = nodes + horizon/(timeToLeave/8) + 1;
+    unborn slots are protocol-dead (`alive` mask — NOT the engine's
+    `down` column, which would drop their birth wake-ups) with a
+    host-sampled `born_at`/`exit_at`/first-broadcast schedule; the birth
+    event wires total_peers links to hash-ranked alive slots.
+  * **Dense adjacency** [M, M] bool replaces the peer lists; link
+    create/remove are symmetric writes; scores read LIVE capabilities —
+    the record is only a discovery ping (design note).
+  * **Scores in closed form**: k_c = matching-cap neighbor counts (one
+    [M, M] @ [M, C] product); score = sum_c k_c * min(k_c, 3)
+    (score_of, ENRGossiping.java:395-409); addedValue and the
+    remove-worst scan are the same expression with one row toggled.
+  * **Per-cap reachability**: isFullyConnected's BFS (:330-360) becomes
+    a boolean-matmul transitive closure per capability, evaluated only
+    for nodes touched by an event this ms (birth or either side of a
+    connect) — the oracle, too, only re-checks on those events.
+  * **Event-driven time**: TICK_INTERVAL=None; births, exits, capability
+    changes and gossip beats are size-0 self-messages with explicit
+    arrivals, so the engine's empty-ms jump skips the (huge: beats are
+    minutes apart) gaps — the batched analog of the oracle's DES queue.
+
+Distribution-level approximations (each deliberate):
+  * joiner peer choice / changed capability sets come from counter-hash
+    top-k draws instead of the oracle's retry loops over its live rd
+    stream (the oracle interleaves those draws with traffic, so stream-
+    exact replay is impossible by construction);
+  * one on_flood peer-evaluation per receiver per ms (the lowest-slot
+    winner); same-ms duplicates still dedup + forward;
+  * same-ms connect races: removals apply before additions, and a
+    same-ms degree check may transiently exceed max_peers by the number
+    of simultaneous connectors (the oracle serializes within the ms).
+
+The oracle's done_at quirk is carried exactly: done_at stores the
+RELATIVE time max(1, t - start_time) (set_done_at, enr_gossiping.py),
+not the absolute time every other protocol stores.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.node import build_node_columns
+from ..core.registries import registry_network_latencies, registry_node_builders
+from ..engine import BatchedNetwork, BatchedProtocol, Emission
+from ..engine.rng import hash32
+from .enr_gossiping import PEERS_PER_CAP, ENRGossiping, ENRParameters
+
+INT32_MAX = np.int32(2**31 - 1)
+
+
+class BatchedENR(BatchedProtocol):
+    MSG_TYPES = ["RECORD", "WAKE"]
+    PAYLOAD_WIDTH = 2  # (source, seq)
+    TICK_INTERVAL = None  # event-driven: wakes carry the schedule
+
+    def __init__(self, params: ENRParameters, m_slots: int, schedule: dict):
+        self.params = params
+        self.m = m_slots
+        self.n_caps = params.number_of_different_capabilities
+        self.schedule = schedule  # host-side columns, see make_enr
+
+    def msg_size(self, mtype: int) -> int:
+        return [1, 0][mtype]  # Record size 1; wakes are task-style
+
+    # -- capability scoring (closed form) ------------------------------------
+    def _kc(self, adj, caps, own):
+        """k_c[i, c] = matching-cap neighbor counts: adjacent holders of c,
+        counted only for c in i's own set."""
+        k = adj.astype(jnp.int32) @ caps.astype(jnp.int32)
+        return k * own.astype(jnp.int32)
+
+    @staticmethod
+    def _score_from_counts(k):
+        """score_of: each cap contributes k_c * min(k_c, PEERS_PER_CAP)."""
+        return jnp.sum(k * jnp.minimum(k, PEERS_PER_CAP), axis=-1)
+
+    def _gen_caps(self, seed, ids, salt):
+        """cap_per_node distinct capabilities per node: top-k of hashed
+        per-cap scores (the oracle's retry loop, distribution-level)."""
+        c = self.n_caps
+        scores = hash32(seed, ids[:, None], jnp.arange(c, dtype=jnp.int32)[None, :], salt)
+        kth = jnp.sort(scores, axis=1)[:, c - self.params.cap_per_node]
+        return scores >= kth[:, None]
+
+    # -- flood forwarding ----------------------------------------------------
+    def _forward(self, state, src, src_of_record, seq, mask, exclude):
+        """Winners forward record (src_of_record, seq) to all their live
+        peers except `exclude`, with Record(local_delay=10,
+        delay_between_peers=10) spacing (enr_gossiping Record ctor)."""
+        adjm = state.proto["adj"]
+        k = src.shape[0]
+        m = self.m
+        src_r = jnp.repeat(src, m)
+        dest = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32)[None, :], (k, m)).reshape(-1)
+        ok = (
+            jnp.repeat(mask, m)
+            & adjm[src].reshape(-1)
+            & (dest != jnp.repeat(exclude, m))
+            & state.proto["alive"][dest]
+        )
+        base = state.time + 1 + 10  # local_delay = 10
+        rank = (jnp.cumsum(ok.reshape(k, m), axis=1) - 1).reshape(-1)
+        send_time = jnp.broadcast_to(base, rank.shape) + rank.astype(jnp.int32) * 11
+        payload = jnp.stack(
+            [jnp.repeat(src_of_record, m), jnp.repeat(seq, m)], axis=1
+        )
+        return Emission(
+            mask=ok,
+            from_idx=src_r,
+            to_idx=dest,
+            mtype=self.mtype("RECORD"),
+            payload=payload,
+            send_time=send_time,
+        )
+
+    def _wake(self, state, ids, mask, arrival):
+        return Emission(
+            mask=mask,
+            from_idx=ids,
+            to_idx=ids,
+            mtype=self.mtype("WAKE"),
+            payload=jnp.zeros((ids.shape[0], 2), jnp.int32),
+            arrival=arrival,
+        )
+
+    # -- state ---------------------------------------------------------------
+    def proto_init(self, n_nodes: int):
+        s = self.schedule
+        return {
+            "alive": jnp.asarray(s["alive0"]),
+            "caps": jnp.asarray(s["caps0"]),
+            "adj": jnp.asarray(s["adj0"]),
+            "seen": jnp.full((self.m, self.m), -1, jnp.int32),
+            "records": jnp.zeros(self.m, jnp.int32),
+            "start_time": jnp.zeros(self.m, jnp.int32),
+            "born_at": jnp.asarray(s["born_at"]),
+            "exit_at": jnp.asarray(s["exit_at"]),
+            "bcast_next": jnp.asarray(s["bcast0"]),
+            "change_next": jnp.asarray(s["change0"]),
+        }
+
+    def initial_emissions(self, net, state):
+        p = self.proto_initial_wakes(state)
+        return p
+
+    def proto_initial_wakes(self, state):
+        proto = state.proto
+        ids = jnp.arange(self.m, dtype=jnp.int32)
+        ems = []
+        for col, guard in (
+            ("born_at", proto["born_at"] > 0),
+            ("exit_at", proto["exit_at"] < INT32_MAX),
+            ("bcast_next", proto["bcast_next"] < INT32_MAX),
+            ("change_next", proto["change_next"] < INT32_MAX),
+        ):
+            ems.append(self._wake(state, ids, guard, proto[col]))
+        return ems
+
+    # -- the event handler ---------------------------------------------------
+    def deliver(self, net, state, deliver_mask):
+        p = self.params
+        proto = state.proto
+        t = state.time
+        m = self.m
+        ids = jnp.arange(m, dtype=jnp.int32)
+        alive, caps, adj = proto["alive"], proto["caps"], proto["adj"]
+        emissions = []
+        touched = jnp.zeros(m, bool)  # nodes needing a done re-check
+
+        # ---- births (the _add_new_node beat, ENRGossiping.java:284-293;
+        # the t=0 joiner is wired host-side in make_enr like the oracle's)
+        born = ~alive & (proto["born_at"] == t) & (proto["born_at"] > 0)
+        # total_peers hash-ranked alive targets per newborn
+        rank = hash32(state.seed, t, ids[:, None], ids[None, :])
+        eligible = alive[None, :] & (ids[None, :] != ids[:, None])
+        rank = jnp.where(eligible, rank & 0x7FFFFFFF, INT32_MAX)
+        order = jnp.argsort(rank, axis=1)[:, : p.total_peers]  # [M, tp]
+        sel_ok = (
+            jnp.take_along_axis(rank, order, axis=1) != INT32_MAX
+        ) & born[:, None]
+        row_new = jnp.zeros((m, m), bool)
+        row_new = row_new.at[
+            jnp.where(sel_ok, ids[:, None], m), jnp.where(sel_ok, order, m)
+        ].set(True, mode="drop")
+        adj = adj | row_new | row_new.T
+        alive = alive | born
+        start_time = jnp.where(born, t, proto["start_time"])
+        touched = touched | born
+
+        # ---- exits (exit_network: disconnect + stop, :198-207)
+        exiting = alive & (proto["exit_at"] == t)
+        keep = ~exiting
+        adj = adj & keep[:, None] & keep[None, :]
+        alive = alive & ~exiting
+
+        # ---- capability changes (change_cap + periodic re-arm)
+        changing = alive & (proto["change_next"] == t)
+        new_caps = self._gen_caps(state.seed, ids, t)
+        caps = jnp.where(changing[:, None], new_caps, caps)
+        change_next = jnp.where(
+            changing, proto["change_next"] + jnp.int32(p.time_to_change), proto["change_next"]
+        )
+        emissions.append(self._wake(state, ids, changing, change_next))
+
+        # ---- gossip beats (broadcast_capabilities + periodic re-arm)
+        bcast = alive & (proto["bcast_next"] == t)
+        announce = bcast | changing  # change_cap also floods a fresh record
+        records = proto["records"]
+        seq_out = records
+        records = records + announce.astype(jnp.int32)
+        # originators never reprocess their own record
+        seen = proto["seen"].at[ids, ids].max(jnp.where(announce, seq_out, -1))
+        bcast_next = jnp.where(
+            bcast, proto["bcast_next"] + jnp.int32(p.cap_gossip_time), proto["bcast_next"]
+        )
+        emissions.append(self._wake(state, ids, bcast, bcast_next))
+
+        state = state._replace(
+            proto=dict(
+                proto,
+                alive=alive,
+                caps=caps,
+                adj=adj,
+                records=records,
+                start_time=start_time,
+                change_next=change_next,
+                bcast_next=bcast_next,
+            )
+        )
+        emissions.append(
+            self._forward(state, ids, ids, seq_out, announce, jnp.full(m, -1, jnp.int32))
+        )
+
+        # ---- record deliveries: dedup, forward, evaluate source as peer
+        is_rec = deliver_mask & (state.msg_type == self.mtype("RECORD"))
+        to = state.msg_to
+        src = state.msg_payload[:, 0]
+        seq = state.msg_payload[:, 1]
+        fresh = is_rec & alive[to] & (seq > seen[to, src])
+        c = deliver_mask.shape[0]
+        slot = jnp.arange(c, dtype=jnp.int32)
+        # highest seq per (to, src) wins the dedup table
+        seen = seen.at[to, src].max(jnp.where(fresh, seq, -1), mode="drop")
+        win = fresh & (seen[to, src] == seq)
+        # winner slot per (to, src) forwards (FloodMessage dedup-and-forward)
+        wslot = jnp.full((m, m), c, jnp.int32)
+        wslot = wslot.at[to, src].min(jnp.where(win, slot, c), mode="drop")
+        fwd = win & (wslot[to, src] == slot)
+        emissions.append(
+            self._forward(state, to, src, seq, fwd, state.msg_from)
+        )
+
+        # one peer-evaluation per receiver per ms: its lowest winning slot
+        rslot = jnp.full(m, c, jnp.int32)
+        rslot = rslot.at[to].min(jnp.where(fwd, slot, c), mode="drop")
+        ev = fwd & (rslot[to] == slot)
+        # gather the (i, s) pairs as per-node columns
+        eval_src = jnp.full(m, -1, jnp.int32)
+        eval_src = eval_src.at[jnp.where(ev, to, m)].set(src, mode="drop")
+        has_eval = eval_src >= 0
+        s_idx = jnp.maximum(eval_src, 0)
+
+        # on_flood (:296-322): canConnect + addedValue + removeWorse
+        adj = state.proto["adj"]
+        caps = state.proto["caps"]
+        deg = jnp.sum(adj, axis=1).astype(jnp.int32)
+        k0 = self._kc(adj, caps, caps)  # [M, C]
+        s0 = self._score_from_counts(k0)  # current score_of(peers)
+        cap_s = caps[s_idx]  # source capabilities [M, C]
+        match_s = (cap_s & caps).astype(jnp.int32)
+        s_add = self._score_from_counts(k0 + match_s)
+        added_value = s_add - s0
+        can = (
+            has_eval
+            & alive
+            & alive[s_idx]
+            & (deg[s_idx] < p.max_peers)
+            & ~adj[ids, s_idx]
+            & (added_value != 0)
+        )
+
+        # removeWorseIfPossible (:417-438): best single-peer swap
+        match_j = (caps[None, :, :] & caps[:, None, :]).astype(jnp.int32)  # [i, j, C]
+        k_swap = k0[:, None, :] - match_j + match_s[:, None, :]
+        s_swap = jnp.where(
+            adj, self._score_from_counts(k_swap), jnp.int32(-(2**30))
+        )  # [i, j]
+        j_best = jnp.argmax(s_swap, axis=1)
+        s_best = jnp.take_along_axis(s_swap, j_best[:, None], axis=1)[:, 0]
+        at_cap = deg >= p.max_peers
+        swap_ok = s_best > s0
+        connect = can & (~at_cap | swap_ok)
+        drop_j = can & at_cap & swap_ok
+
+        # removals first, then additions (same-ms race policy, see header)
+        r_i = jnp.where(drop_j, ids, m)
+        r_j = jnp.where(drop_j, j_best, m)
+        adj = adj.at[r_i, r_j].set(False, mode="drop")
+        adj = adj.at[r_j, r_i].set(False, mode="drop")
+        a_i = jnp.where(connect, ids, m)
+        a_j = jnp.where(connect, s_idx, m)
+        adj = adj.at[a_i, a_j].set(True, mode="drop")
+        adj = adj.at[a_j, a_i].set(True, mode="drop")
+        touched = touched | connect
+        touched = touched | jnp.zeros(m, bool).at[a_j].set(connect, mode="drop")
+
+        state = state._replace(proto=dict(state.proto, adj=adj, seen=seen))
+
+        # ---- done checks for touched nodes (isFullyConnected, :226-248)
+        done_now = touched & alive & (state.done_at == 0) & self._fully_connected(
+            state.proto
+        )
+        rel = jnp.maximum(1, t - state.proto["start_time"])
+        state = state._replace(
+            done_at=jnp.where(done_now, rel, state.done_at)
+        )
+        return state, emissions
+
+    def _fully_connected(self, proto):
+        """score >= 3*|caps| and every own capability's subgraph reaches at
+        least half that capability's alive holders (BFS -> boolean-matmul
+        closure)."""
+        alive, caps, adj = proto["alive"], proto["caps"], proto["adj"]
+        m = self.m
+        k = self._kc(adj, caps, caps)
+        score_ok = self._score_from_counts(k) >= self.params.cap_per_node * PEERS_PER_CAP
+
+        holders = caps & alive[:, None]  # [M, C]
+        # cap-confined adjacency, reflexive closure by squaring (boolean
+        # matmuls as int32 contractions)
+        a_c = (
+            adj[None, :, :]
+            & holders.T[:, :, None]
+            & holders.T[:, None, :]
+        )  # [C, M, M]
+        reach = (a_c | jnp.eye(m, dtype=bool)[None, :, :]).astype(jnp.int32)
+        for _ in range(max(1, int(np.ceil(np.log2(max(2, m)))))):
+            reach = jnp.minimum(reach + reach @ reach, 1)
+        starts = (adj[None, :, :] & holders.T[:, None, :]).astype(jnp.int32)
+        explored = (starts @ reach) > 0  # [C, i, k]: reachable holders
+        explored = explored | jnp.eye(m, dtype=bool)[None, :, :]  # self counts
+        count = jnp.sum(explored, axis=2).T  # [M, C]
+        threshold = (jnp.sum(holders, axis=0) // 2)[None, :]  # [1, C]
+        ok_c = jnp.where(caps, count >= threshold, True)
+        return score_ok & jnp.all(ok_c, axis=1)
+
+    def all_done(self, state):
+        return jnp.all(
+            jnp.where(state.proto["alive"], state.done_at > 0, True)
+        )
+
+
+def make_enr(
+    params: Optional[ENRParameters] = None,
+    horizon_ms: int = 4_000_000,
+    capacity: int = 1 << 12,
+    seed: int = 0,
+):
+    """Host-side construction: run the oracle's init() for the initial
+    population (same caps/graph/changing-node draws), pre-sample the join/
+    exit/beat schedule with the continuing rd stream, bake into the engine.
+
+    `horizon_ms` bounds the join schedule: one slot per timeToLeave/8 beat
+    up to the horizon (ENRGossiping.java:284-293); running past it simply
+    stops producing joiners."""
+    params = params or ENRParameters()
+    oracle = ENRGossiping(params)
+    oracle.init()
+    onet = oracle.network()
+    rd = onet.rd
+
+    n0 = params.nodes
+    period = params.time_to_leave // 8
+    n_join = min(horizon_ms // period + 1, 4096)
+    m = n0 + int(n_join)
+
+    caps0 = np.zeros((m, params.number_of_different_capabilities), bool)
+    adj0 = np.zeros((m, m), bool)
+    alive0 = np.zeros(m, bool)
+    for i, nd in enumerate(onet.all_nodes):
+        alive0[i] = not nd.is_down()
+        for cap in nd.capabilities:
+            caps0[i, int(cap.split("_")[1])] = True
+        for pr in nd.peers:
+            adj0[i, pr.node_id] = True
+    # future joiners: caps + schedule from the continuing rd stream
+    born_at = np.zeros(m, np.int32)
+    exit_at = np.full(m, INT32_MAX, np.int32)
+    bcast0 = np.full(m, INT32_MAX, np.int32)
+    change0 = np.full(m, INT32_MAX, np.int32)
+    for j in range(n_join):
+        i = n0 + j
+        born_at[i] = j * period
+        caps_set = set()
+        while len(caps_set) < params.cap_per_node:
+            caps_set.add(rd.next_int(params.number_of_different_capabilities))
+        for cap_i in caps_set:
+            caps0[i, cap_i] = True
+        if j == 0:
+            # the oracle's first joiner arrives at t=0, inside init: wire
+            # it host-side (the jit birth mask only fires for t > 0)
+            alive0[i] = True
+            wired = 0
+            while wired < params.total_peers:
+                tgt = rd.next_int(n0 + 1)
+                if tgt != i and alive0[tgt] and not adj0[i, tgt]:
+                    adj0[i, tgt] = adj0[tgt, i] = True
+                    wired += 1
+        if born_at[i] > 1:
+            exit_at[i] = born_at[i] + rd.next_int(params.time_to_leave)
+        b = born_at[i] + rd.next_int(params.cap_gossip_time) + 1
+        if b < exit_at[i]:
+            bcast0[i] = b
+    # initial nodes: broadcast beats (start() for t=0 nodes: no exit)
+    for i in range(n0):
+        bcast0[i] = rd.next_int(params.cap_gossip_time) + 1
+    # capability-change schedule: the oracle drew these inside init(); the
+    # draws here are fresh from the continuing stream (distribution-level)
+    for nd in oracle.changed_nodes:
+        change0[nd.node_id] = rd.next_int(params.time_to_change) + 1
+
+    schedule = {
+        "alive0": alive0,
+        "caps0": caps0,
+        "adj0": adj0,
+        "born_at": born_at,
+        "exit_at": exit_at,
+        "bcast0": bcast0,
+        "change0": change0,
+    }
+    proto = BatchedENR(params, m, schedule)
+
+    latency = registry_network_latencies.get_by_name(params.network_latency_name)
+    city_index = getattr(latency, "city_index", None)
+    # node columns: oracle nodes + future joiners drawn with the same builder
+    from ..core.node import Node
+
+    nodes = list(onet.all_nodes)
+    nb = registry_node_builders.get_by_name(params.node_builder_name)
+    while len(nodes) < m:
+        nodes.append(Node(rd, nb))
+    cols = build_node_columns(nodes, city_index)
+    net = BatchedNetwork(proto, latency, m, capacity=capacity)
+    state = net.init_state(cols, seed=seed, proto=proto.proto_init(m))
+
+    # t=0 fully-connected marks (start() -> set_done_at at birth): host-side
+    import jax
+
+    done0 = np.asarray(
+        jax.jit(proto._fully_connected)(state.proto)
+    ) & alive0
+    state = state._replace(
+        done_at=jnp.where(jnp.asarray(done0), jnp.int32(1), state.done_at)
+    )
+    return net, state

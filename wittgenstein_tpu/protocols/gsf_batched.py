@@ -3,8 +3,11 @@
 Re-expression of protocols/GSFSignature.java (via the oracle port
 protocols/gsf.py) on the shared bitset-aggregation machinery
 (_agg_batched.BitsetAggBase): XOR-relative packed bitsets, per-level
-exact-width channel slots + freshest-offer backstop, and a one-slot
-verification register committing at t + pairingTime.
+channel slots + freshest-offer backstop, and a one-slot verification
+register committing at t + pairingTime.  Like batched Handel, every
+per-level computation runs once per width BUCKET on a stacked level
+axis, and the per-level send loops (dissemination, accelerated calls)
+collapse into single stacked sends — the r4 program-size rewrite.
 
 GSF specifics vs Handel:
 
@@ -50,7 +53,7 @@ from ..core.node import Node, build_node_columns
 from ..core.registries import registry_network_latencies, registry_node_builders
 from ..engine import BatchedNetwork
 from ..engine.rng import hash32
-from ..ops.bitops import block_mask, popcount_words, xor_shuffle
+from ..ops.bitops import block_mask, popcount_words
 from ..utils.javarand import JavaRandom
 from ._agg_batched import INT32_MAX, BitsetAggBase
 from .gsf import GSFSignatureParameters
@@ -78,8 +81,12 @@ class BatchedGSF(BitsetAggBase):
         n, L, K = self.n_nodes, self.n_levels, self.CAND_SLOTS
         own = np.zeros((n, self.n_words), dtype=np.uint32)
         own[:, 0] = 1  # bit 0 = own signature (level 0)
-        in_key, in_sig = self._channel_init(n)
+        in_key, in_sigs = self._channel_init(n)
         ss = self.CHANNEL_DEPTH + 1
+        cand_sigs = {
+            f"cand_sig{i}": jnp.zeros((n, b.nl * K * b.w_pad), jnp.uint32)
+            for i, b in enumerate(self.buckets)
+        }
         remaining = np.zeros((n, L), dtype=np.int32)
         for l in range(1, L):
             remaining[:, l] = 1 << (l - 1)
@@ -89,11 +96,12 @@ class BatchedGSF(BitsetAggBase):
             "ind_seen": jnp.zeros((n, self.n_words), jnp.uint32),
             "pend_ind": jnp.zeros((n, self.n_words), jnp.uint32),
             "in_key": in_key,
-            "in_sig": in_sig,
+            **in_sigs,
+            "displaced": jnp.int32(0),
             "in_aux": jnp.zeros((n, (L - 1) * ss), jnp.int32),  # prefix k
             "cand_key": jnp.full((n, (L - 1) * K), INT32_MAX, jnp.int32),  # rel
             "cand_pk": jnp.zeros((n, (L - 1) * K), jnp.int32),
-            "cand_sig": jnp.zeros((n, K * self.w_total), jnp.uint32),
+            **cand_sigs,
             "ver_active": jnp.zeros(n, bool),
             "ver_done_t": jnp.zeros(n, jnp.int32),
             "ver_level": jnp.zeros(n, jnp.int32),
@@ -113,24 +121,20 @@ class BatchedGSF(BitsetAggBase):
         (getLastFinishedLevel): the verified union is then >= [0, 2^k)."""
         if self.n_levels == 1:
             return jnp.zeros(ver.shape[0], jnp.int32)
-        comp = jnp.stack(
+        comp = self._level_stats(
             [
-                popcount_words(self._blk(ver, l)) == (1 << (l - 1))
-                for l in range(1, self.n_levels)
-            ],
-            axis=1,
+                popcount_words(self._blocks(ver, b))
+                == jnp.asarray([self.bs[l] for l in b.levels], jnp.int32)[None, :]
+                for b in self.buckets
+            ]
         )
         return jnp.sum(jnp.cumprod(comp.astype(jnp.int32), axis=1), axis=1)
 
-    def _eval_sig(self, l: int, sig, ver_b, indiv_b):
-        """evaluateSig (GSFSignature.java:478-520) on block-local [N, K, w]
-        candidates; sig may be [N, w] too (broadcast over K)."""
-        bs = 1 << (l - 1)
-        if sig.ndim == ver_b.ndim:
-            sig = sig[:, None, :]
-        vb = ver_b[:, None, :]
-        ib = indiv_b[:, None, :]
-        ver_card = popcount_words(ver_b)[:, None]
+    def _eval_sig(self, sig, vb, ib, bs, lv):
+        """evaluateSig (GSFSignature.java:478-520), broadcast-generic:
+        sig/vb/ib are [..., w] (broadcastable against each other), bs/lv
+        broadcast against the popcount shapes."""
+        ver_card = popcount_words(vb)
         sig_card = popcount_words(sig)
         inter = popcount_words(sig & vb) > 0
         with_ind = sig | ib
@@ -149,142 +153,172 @@ class BatchedGSF(BitsetAggBase):
             indiv_fallback,
             jnp.where(
                 new_total == bs,
-                1_000_000 - l * 10,
-                100_000 - l * 100 + added,
+                1_000_000 - lv * 10,
+                100_000 - lv * 100 + added,
             ),
         )
         return jnp.where(ver_card >= bs, 0, score)
 
+    def _bs_arr(self, b):
+        return jnp.asarray([self.bs[l] for l in b.levels], jnp.int32)
+
     # -- tick phase 1: commit due verifications ------------------------------
     def _commit(self, net, state):
-        """updateVerifiedSignatures (GSFSignature.java:379-460)."""
+        """updateVerifiedSignatures (GSFSignature.java:379-460), stacked."""
         p = self.params
         proto = state.proto
         t = state.time
         n, L = self.n_nodes, self.n_levels
         ids = jnp.arange(n, dtype=jnp.int32)
+        lv_all = jnp.arange(1, L, dtype=jnp.int32)
+        bs_all = jnp.asarray(self.lv_bs)
 
         due = proto["ver_active"] & (t >= proto["ver_done_t"])
         ver, indiv = proto["ver"], proto["indiv"]
         remaining = proto["remaining"]
         rel = proto["ver_rel"]
         pk = proto["ver_pk"]
+        lvl = proto["ver_level"]
+
+        # absorb the completed prefix (:397-411) at full width first: the
+        # sender's consecutive-complete levels cover [0, 2^pk), which
+        # includes the committed block and the receiver's levels 1..pk
+        absorb = due & (pk >= lvl)
+        interval = jnp.asarray(self.pref_masks)[jnp.clip(pk, 0, L - 1)]
+        newly = popcount_words(interval & ~ver) > 0
+        reset_r = absorb & newly
+        ver_a = jnp.where(absorb[:, None], ver | interval, ver)
 
         improved_any = jnp.zeros(n, bool)
-        for l in range(1, L):
-            bs = 1 << (l - 1)
-            m = due & (proto["ver_level"] == l)
-            r0 = rel & (bs - 1)
-            sig_b = proto["ver_sig"][:, : self.w[l]]
-            ver_b = self._blk(ver, l)
-            indiv_b = self._blk(indiv, l)
+        ver_pieces, indiv_pieces = [], []
+        for i, b in enumerate(self.buckets):
+            lv = jnp.asarray(b.levels, jnp.int32)
+            bs = self._bs_arr(b)
+            m = due[:, None] & (lvl[:, None] == lv[None, :])  # [N, nl]
+            r0 = rel[:, None] & (bs[None, :] - 1)
+            sig_b = proto["ver_sig"][:, None, : b.w_pad]
+            ver_b = self._blocks(ver_a, b)  # post-absorb ("may now be complete")
+            indiv_b = self._blocks(indiv, b)
 
             # individual sig: set the indiv bit first (:383-385)
-            single = m & proto["ver_single"]
-            oh = self._onehot(r0, self.w[l])
-            new_indiv_b = jnp.where(single[:, None], indiv_b | oh, indiv_b)
+            single = m & proto["ver_single"][:, None]
+            oh = self._onehot(r0, b.w_pad)
+            new_indiv_b = jnp.where(single[..., None], indiv_b | oh, indiv_b)
             # holder.sigs |= indivVerifiedSig (:386)
             sigs = sig_b | new_indiv_b
 
-            # absorb the completed prefix (:397-411): pk >= l means the
-            # sender's consecutive-complete levels cover [0, 2^pk), which
-            # includes this block and the receiver's levels 1..pk
-            absorb = m & (pk >= l)
-            interval = jnp.asarray(self.pref_masks)[jnp.minimum(pk, L - 1)]
-            newly = popcount_words(interval & ~ver) > 0
-            reset_r = absorb & newly
-            ver = jnp.where(absorb[:, None], ver | interval, ver)
-            ver_b = self._blk(ver, l)  # may now be complete
-            full_block = jnp.full((n, 1), (1 << bs) - 1, jnp.uint32) if bs < 32 else jnp.full(
-                (n, self.w[l]), 0xFFFFFFFF, jnp.uint32
+            # absorbed commits act as a full block at the committed level
+            full_block = jnp.asarray(
+                np.stack(
+                    [
+                        np.asarray(
+                            [
+                                0xFFFFFFFF
+                                if (j + 1) * 32 <= self.bs[l]
+                                else ((1 << (self.bs[l] % 32)) - 1 if j * 32 < self.bs[l] else 0)
+                                for j in range(b.w_pad)
+                            ],
+                            np.uint32,
+                        )
+                        for l in b.levels
+                    ]
+                )
             )
-            sigs = jnp.where(absorb[:, None], full_block, sigs)
+            sigs = jnp.where(
+                (m & absorb[:, None])[..., None], full_block[None, :, :], sigs
+            )
 
             # disjoint sets aggregate (:413-417)
             disjoint = (popcount_words(ver_b) > 0) & (
                 popcount_words(sigs & ver_b) == 0
             )
-            sigs = jnp.where((m & disjoint)[:, None], sigs | ver_b, sigs)
+            sigs = jnp.where((m & disjoint)[..., None], sigs | ver_b, sigs)
 
             # replacement on improvement (:419-431)
             improve = m & (
-                (popcount_words(sigs) > popcount_words(ver_b)) | reset_r
+                (popcount_words(sigs) > popcount_words(ver_b))
+                | reset_r[:, None]
             )
-            ver = self._blk_write(ver, l, sigs, improve)
-            indiv = self._blk_write(indiv, l, new_indiv_b, m)
+            ver_pieces.append(jnp.where(improve[..., None], sigs, ver_b))
+            indiv_pieces.append(jnp.where(m[..., None], new_indiv_b, indiv_b))
+            improved_any = improved_any | jnp.any(improve, axis=1)
 
-            # reset send budgets for levels >= l (:421-423)
-            lv_idx = jnp.arange(L, dtype=jnp.int32)[None, :]
-            sizes = jnp.asarray(
-                [0] + [1 << (j - 1) for j in range(1, L)], jnp.int32
-            )[None, :]
-            remaining = jnp.where(
-                improve[:, None] & (lv_idx >= l), sizes, remaining
-            )
-            improved_any = improved_any | improve
+        ver = self._assemble(ver_a, ver_pieces)
+        indiv = self._assemble(indiv, indiv_pieces)
 
-        # accelerated calls (:438-451): after the merges, burst the
-        # completed prefix to fresh peers of each level it now covers.
-        # Each node committed at exactly one level (ver_level), so one
-        # send per target level mm covers every row: burst at mm iff the
-        # commit improved, mm > committed level, and the new prefix k
-        # reaches mm-1.
+        # reset send budgets for levels >= the committed level (:421-423)
+        lv_idx = jnp.arange(L, dtype=jnp.int32)[None, :]
+        sizes = jnp.asarray([0] + [1 << (j - 1) for j in range(1, L)], jnp.int32)
+        remaining = jnp.where(
+            improved_any[:, None] & (lv_idx >= lvl[:, None]), sizes[None, :], remaining
+        )
+
         state = state._replace(
             proto=dict(proto, ver=ver, indiv=indiv, remaining=remaining)
         )
+
+        # accelerated calls (:438-451): after the merges, burst the
+        # completed prefix to fresh peers of each level it now covers.
+        # Each node committed at exactly one level (ver_level); burst at
+        # level mm iff the commit improved, mm > committed level, and the
+        # new prefix k reaches mm-1.  One stacked send over [N, L-1, acc].
         if p.accelerated_calls_count > 0 and L > 2:
             k_new = self._prefix_k(ver)
-            lvl = proto["ver_level"]
             acc = p.accelerated_calls_count
-            havings = ver | jnp.asarray(self.pref_masks)[jnp.minimum(k_new, L - 1)]
-            for mm in range(2, L):
-                bsm = 1 << (mm - 1)
-                fan = min(acc, bsm)
-                proto_c = state.proto
-                remaining = proto_c["remaining"]
-                burst = improved_any & (lvl < mm) & (k_new >= mm - 1)
-                take = jnp.where(
-                    burst, jnp.minimum(jnp.maximum(remaining[:, mm], 0), fan), 0
-                )
-                offset = hash32(state.seed, ids, jnp.int32(mm), t) & (bsm - 1)
-                js = jnp.arange(fan, dtype=jnp.int32)
-                relb = bsm + (
-                    (proto_c["pos"][:, mm, None] + offset[:, None] + js[None, :])
-                    & (bsm - 1)
-                )
-                mask_b = js[None, :] < take[:, None]
-                state = state._replace(
-                    proto=dict(
-                        proto_c, remaining=remaining.at[:, mm].add(-take)
-                    )
-                )
-                content = self._low(havings, mm)
-                state = self._send_level(
-                    net,
-                    state,
-                    mm,
-                    mask_b.reshape(-1),
-                    jnp.repeat(ids, fan),
-                    (ids[:, None] ^ relb).reshape(-1),
-                    jnp.repeat(content, fan, axis=0),
-                    aux=jnp.repeat(k_new, fan),
-                )
-        proto = state.proto
-        ver, indiv, remaining = proto["ver"], proto["indiv"], proto["remaining"]
+            havings = ver | jnp.asarray(self.pref_masks)[jnp.clip(k_new, 0, L - 1)]
+            fan = jnp.minimum(jnp.int32(acc), bs_all)  # [L-1]
+            burst = (
+                improved_any[:, None]
+                & (lvl[:, None] < lv_all[None, :])
+                & (k_new[:, None] >= lv_all[None, :] - 1)
+                & (lv_all[None, :] >= 2)
+            )  # [N, L-1]
+            take = jnp.where(
+                burst,
+                jnp.minimum(jnp.maximum(remaining[:, 1:], 0), fan[None, :]),
+                0,
+            )
+            remaining = remaining.at[:, 1:].add(-take)
+            state = state._replace(proto=dict(state.proto, remaining=remaining))
 
-        total = popcount_words(ver)
+            ks = jnp.arange(acc, dtype=jnp.int32)
+            offset = hash32(state.seed, ids[:, None], lv_all[None, :], t) & (
+                bs_all[None, :] - 1
+            )  # [N, L-1]
+            relb = bs_all[None, :, None] + (
+                (proto["pos"][:, 1:, None] + offset[:, :, None] + ks[None, None, :])
+                & (bs_all[None, :, None] - 1)
+            )  # [N, L-1, acc]
+            mask_b = ks[None, None, :] < take[:, :, None]
+            content = []
+            for b in self.buckets:
+                lows = self._lows(havings, b)  # [N, nl, w_pad]
+                full = jnp.zeros((n, L - 1, b.w_pad), jnp.uint32)
+                full = full.at[:, b.lo - 1 : b.hi, :].set(lows)
+                content.append(
+                    jnp.broadcast_to(
+                        full[:, :, None, :], (n, L - 1, acc, b.w_pad)
+                    ).reshape(n * (L - 1) * acc, b.w_pad)
+                )
+            state = self._send_stacked(
+                net,
+                state,
+                mask_b.reshape(-1),
+                jnp.repeat(ids, (L - 1) * acc),
+                (ids[:, None, None] ^ relb).reshape(-1),
+                jnp.broadcast_to(lv_all[None, :, None], (n, L - 1, acc)).reshape(-1),
+                content,
+                aux=jnp.repeat(k_new, (L - 1) * acc),
+            )
+
+        proto = state.proto
+        total = popcount_words(proto["ver"])
         done_now = (
             improved_any & (state.done_at == 0) & ~state.down & (total >= p.threshold)
         )
         state = state._replace(
             done_at=jnp.where(done_now, t, state.done_at),
-            proto=dict(
-                proto,
-                ver=ver,
-                indiv=indiv,
-                remaining=remaining,
-                ver_active=proto["ver_active"] & ~due,
-            ),
+            proto=dict(proto, ver_active=proto["ver_active"] & ~due),
         )
         return state
 
@@ -295,83 +329,91 @@ class BatchedGSF(BitsetAggBase):
         proto = state.proto
         n, L, K = self.n_nodes, self.n_levels, self.CAND_SLOTS
         rel_mask = (1 << self.rel_bits) - 1
+        ss = self.CHANNEL_DEPTH + 1
 
         in_key, due_all, empty_tpl = self._advance_channel(proto["in_key"])
+        keys3 = self._keys_stacked(in_key)
+        due3 = due_all.reshape(n, L - 1, ss)
+        rel3 = keys3 & rel_mask
+        pk3 = proto["in_aux"].reshape(n, L - 1, ss)
 
-        new_cand_key = proto["cand_key"]
-        new_cand_pk = proto["cand_pk"]
-        new_cand_sig = proto["cand_sig"]
-        new_pend = proto["pend_ind"]
-        new_seen = proto["ind_seen"]
         ver, indiv = proto["ver"], proto["indiv"]
+        seen, pend = proto["ind_seen"], proto["pend_ind"]
 
-        for l in range(1, L):
-            bs = 1 << (l - 1)
-            ss = self.CHANNEL_DEPTH + 1
-            keys = self._key_seg(in_key, l)
-            due = self._key_seg(due_all, l)
-            rel = keys & rel_mask
-            r0 = rel & (bs - 1)
-            pk_new = self._key_seg(proto["in_aux"], l)
+        key_pieces, pk_pieces = [], []
+        cand_sig_updates = {}
+        seen_pieces, pend_pieces = [], []
+        for i, b in enumerate(self.buckets):
+            sl = slice(b.lo - 1, b.hi)
+            lv = jnp.asarray(b.levels, jnp.int32)
+            bs = self._bs_arr(b)
+            due = due3[:, sl, :]
+            rel = rel3[:, sl, :]
+            r0 = rel & (bs[None, :, None] - 1)
+            sig_new = self._arrived_blocks(proto, i, r0)  # [N, nl, ss, w_pad]
+            pk_new = pk3[:, sl, :]
 
-            sig_new = xor_shuffle(self._sig_seg(proto["in_sig"], l, ss), r0)
-
-            # individual sig enqueue: once per sender per level (the bit
-            # position in rel space IS the level block)
-            oh_rows = jnp.zeros((n, self.n_words), jnp.uint32)
-            for d in range(ss):
-                reld = rel[:, d]
-                hot = self._onehot(reld, self.n_words)
-                oh_rows = oh_rows | jnp.where(due[:, d, None], hot, 0)
-            fresh_ind = oh_rows & ~new_seen
-            new_seen = new_seen | fresh_ind
-            new_pend = new_pend | fresh_ind
+            # individual sig enqueue: once per sender per level — the bit
+            # lives in the level block, so track it block-locally and
+            # reassemble (no full-width onehot per slot)
+            oh = jnp.where(
+                due[..., None], self._onehot(r0, b.w_pad), jnp.uint32(0)
+            )  # [N, nl, ss, w_pad]
+            arrived_bits = jnp.bitwise_or.reduce(oh, axis=2)  # [N, nl, w_pad]
+            seen_b = self._blocks(seen, b)
+            pend_b = self._blocks(pend, b)
+            fresh = arrived_bits & ~seen_b
+            seen_pieces.append(seen_b | fresh)
+            pend_pieces.append(pend_b | fresh)
 
             # merge [K existing + ss new] candidates, keep top-K by score
-            c_key = proto["cand_key"][:, (l - 1) * K : l * K]
-            c_pk = proto["cand_pk"][:, (l - 1) * K : l * K]
-            c_sig = self._sig_seg(proto["cand_sig"], l, K)
+            c_key = proto["cand_key"].reshape(n, L - 1, K)[:, sl, :]
+            c_pk = proto["cand_pk"].reshape(n, L - 1, K)[:, sl, :]
+            c_sig = self._sig_view(proto, i, K, prefix="cand_sig")
 
             all_key = jnp.concatenate(
-                [c_key, jnp.where(due, rel, INT32_MAX)], axis=1
+                [c_key, jnp.where(due, rel, INT32_MAX)], axis=2
             )
-            all_pk = jnp.concatenate([c_pk, pk_new], axis=1)
-            all_sig = jnp.concatenate([c_sig, sig_new], axis=1)
+            all_pk = jnp.concatenate([c_pk, pk_new], axis=2)
+            all_sig = jnp.concatenate([c_sig, sig_new], axis=2)
             valid = all_key != INT32_MAX
 
-            ver_b = self._blk(ver, l)
-            indiv_b = self._blk(indiv, l)
+            ver_b = self._blocks(ver, b)
+            indiv_b = self._blocks(indiv, b)
             # prefix-carrying candidates are full-block in this level, so
             # the exact evaluateSig on block content scores them correctly
-            score = self._eval_sig(l, all_sig, ver_b, indiv_b)
+            score = self._eval_sig(
+                all_sig,
+                ver_b[:, :, None, :],
+                indiv_b[:, :, None, :],
+                bs[None, :, None],
+                lv[None, :, None],
+            )
             score = jnp.where(valid, score, -1)
             # drop worthless entries (checkSigs' iterator remove, :532-537)
             score = jnp.where(score == 0, -1, score)
 
-            order = jnp.argsort(-score, axis=1)[:, :K]
-            top_ok = jnp.take_along_axis(score, order, axis=1) > 0
+            order = jnp.argsort(-score, axis=2)[:, :, :K]
+            top_ok = jnp.take_along_axis(score, order, axis=2) > 0
             sel_key = jnp.where(
-                top_ok, jnp.take_along_axis(all_key, order, axis=1), INT32_MAX
+                top_ok, jnp.take_along_axis(all_key, order, axis=2), INT32_MAX
             )
-            sel_pk = jnp.take_along_axis(all_pk, order, axis=1)
-            sel_sig = jnp.take_along_axis(all_sig, order[..., None], axis=1)
+            sel_pk = jnp.take_along_axis(all_pk, order, axis=2)
+            sel_sig = jnp.take_along_axis(all_sig, order[..., None], axis=2)
 
-            new_cand_key = new_cand_key.at[:, (l - 1) * K : l * K].set(sel_key)
-            new_cand_pk = new_cand_pk.at[:, (l - 1) * K : l * K].set(sel_pk)
-            o, wk = self.off[l] * K, self.w[l] * K
-            new_cand_sig = new_cand_sig.at[:, o : o + wk].set(
-                sel_sig.reshape(n, wk)
-            )
+            key_pieces.append(sel_key)
+            pk_pieces.append(sel_pk)
+            cand_sig_updates[f"cand_sig{i}"] = sel_sig.reshape(n, b.nl * K * b.w_pad)
 
         state = state._replace(
             proto=dict(
                 proto,
                 in_key=jnp.where(due_all, empty_tpl[None, :], in_key),
-                cand_key=new_cand_key,
-                cand_pk=new_cand_pk,
-                cand_sig=new_cand_sig,
-                pend_ind=new_pend,
-                ind_seen=new_seen,
+                cand_key=jnp.concatenate(key_pieces, axis=1).reshape(n, (L - 1) * K),
+                cand_pk=jnp.concatenate(pk_pieces, axis=1).reshape(n, (L - 1) * K),
+                pend_ind=self._assemble(pend, pend_pieces),
+                ind_seen=self._assemble(seen, seen_pieces),
+                **cand_sig_updates,
             )
         )
         return state
@@ -379,44 +421,62 @@ class BatchedGSF(BitsetAggBase):
     # -- tick phase 3: periodic dissemination --------------------------------
     def _dissemination(self, net, state):
         """doCycle over started levels with send budgets
-        (GSFSignature.java:289-343)."""
+        (GSFSignature.java:289-343), all levels in ONE stacked send."""
         p = self.params
         proto = state.proto
         t = state.time
-        ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
+        n, L = self.n_nodes, self.n_levels
+        ids = jnp.arange(n, dtype=jnp.int32)
+        lv_all = jnp.arange(1, L, dtype=jnp.int32)
+        bs_all = jnp.asarray(self.lv_bs)
 
-        on_beat = (t >= 1) & (
-            lax.rem(t - 1, jnp.int32(p.period_duration_ms)) == 0
-        )
+        on_beat = (t >= 1) & (lax.rem(t - 1, jnp.int32(p.period_duration_ms)) == 0)
         may_send = on_beat & ~state.down
 
         k = self._prefix_k(proto["ver"])
         havings = proto["ver"] | jnp.asarray(self.pref_masks)[
-            jnp.minimum(k, self.n_levels - 1)
+            jnp.clip(k, 0, L - 1)
         ]
-        new_pos = proto["pos"]
-        new_remaining = proto["remaining"]
-        for l in range(1, self.n_levels):
-            bs = 1 << (l - 1)
-            content = self._low(havings, l)
-            started = (t >= l * p.timeout_per_level_ms) | (
-                popcount_words(content) >= bs
-            )
-            mask = may_send & started & (new_remaining[:, l] > 0)
-            offset = hash32(state.seed, ids, jnp.int32(l)) & (bs - 1)
-            rel = (bs + ((new_pos[:, l] + offset) & (bs - 1))).astype(jnp.int32)
-            new_pos = new_pos.at[:, l].set(
-                jnp.where(mask, new_pos[:, l] + 1, new_pos[:, l])
-            )
-            new_remaining = new_remaining.at[:, l].add(-mask.astype(jnp.int32))
-            state = state._replace(
-                proto=dict(state.proto, pos=new_pos, remaining=new_remaining)
-            )
-            state = self._send_level(
-                net, state, l, mask, ids, ids ^ rel, content, aux=k
-            )
-            new_pos = state.proto["pos"]
-            new_remaining = state.proto["remaining"]
+        complete = self._level_stats(
+            [
+                popcount_words(self._lows(havings, b)) >= self._bs_arr(b)[None, :]
+                for b in self.buckets
+            ]
+        )
+        started = (t >= lv_all[None, :] * jnp.int32(p.timeout_per_level_ms)) | complete
+        remaining = proto["remaining"][:, 1:]
+        mask = may_send[:, None] & started & (remaining > 0)  # [N, L-1]
+
+        offset = hash32(state.seed, ids[:, None], lv_all[None, :]) & (
+            bs_all[None, :] - 1
+        )
+        pos = proto["pos"][:, 1:]
+        rel = (bs_all[None, :] + ((pos + offset) & (bs_all[None, :] - 1))).astype(
+            jnp.int32
+        )
+        new_pos = proto["pos"].at[:, 1:].set(jnp.where(mask, pos + 1, pos))
+        new_remaining = proto["remaining"].at[:, 1:].add(-mask.astype(jnp.int32))
+        state = state._replace(
+            proto=dict(proto, pos=new_pos, remaining=new_remaining)
+        )
+
+        content = []
+        for b in self.buckets:
+            lows = self._lows(havings, b)
+            full = jnp.zeros((n, L - 1, b.w_pad), jnp.uint32)
+            full = full.at[:, b.lo - 1 : b.hi, :].set(lows)
+            content.append(full.reshape(n * (L - 1), b.w_pad))
+
+        state = self._send_stacked(
+            net,
+            state,
+            mask.reshape(-1),
+            jnp.repeat(ids, L - 1),
+            (ids[:, None] ^ rel).reshape(-1),
+            jnp.broadcast_to(lv_all[None, :], (n, L - 1)).reshape(-1),
+            content,
+            aux=jnp.repeat(k, L - 1),
+        )
         return state
 
     # -- tick phase 4: start verifications (checkSigs) -----------------------
@@ -431,81 +491,105 @@ class BatchedGSF(BitsetAggBase):
         free = ~proto["ver_active"] & ~state.down & (t >= 1)
         ver, indiv, pend = proto["ver"], proto["indiv"], proto["pend_ind"]
 
-        best_score = jnp.zeros(n, jnp.int32)
-        best_level = jnp.zeros(n, jnp.int32)
-        best_rel = jnp.zeros(n, jnp.int32)
-        best_pk = jnp.zeros(n, jnp.int32)
-        best_kidx = jnp.full(n, -1, jnp.int32)  # -1 = individual pending bit
-        new_cand_key = proto["cand_key"]
-        for l in range(1, L):
-            bs = 1 << (l - 1)
-            c_key = proto["cand_key"][:, (l - 1) * K : l * K]
-            c_pk = proto["cand_pk"][:, (l - 1) * K : l * K]
-            c_sig = self._sig_seg(proto["cand_sig"], l, K)
+        score_p, rel_p, pk_p, kidx_p = [], [], [], []
+        key_pieces, pend_pieces = [], []
+        for i, b in enumerate(self.buckets):
+            sl = slice(b.lo - 1, b.hi)
+            lv = jnp.asarray(b.levels, jnp.int32)
+            bs = self._bs_arr(b)
+            c_key = proto["cand_key"].reshape(n, L - 1, K)[:, sl, :]
+            c_pk = proto["cand_pk"].reshape(n, L - 1, K)[:, sl, :]
+            c_sig = self._sig_view(proto, i, K, prefix="cand_sig")
             valid = c_key != INT32_MAX
-            ver_b = self._blk(ver, l)
-            indiv_b = self._blk(indiv, l)
-            score = jnp.where(valid, self._eval_sig(l, c_sig, ver_b, indiv_b), -1)
-            # curation: drop worthless entries permanently
-            new_cand_key = new_cand_key.at[:, (l - 1) * K : l * K].set(
-                jnp.where(score == 0, INT32_MAX, c_key)
+            ver_b = self._blocks(ver, b)
+            indiv_b = self._blocks(indiv, b)
+            score = self._eval_sig(
+                c_sig,
+                ver_b[:, :, None, :],
+                indiv_b[:, :, None, :],
+                bs[None, :, None],
+                lv[None, :, None],
             )
-            kbest = jnp.argmax(score, axis=1)
-            sbest = jnp.take_along_axis(score, kbest[:, None], axis=1)[:, 0]
+            score = jnp.where(valid, score, -1)
+            # curation: drop worthless entries permanently
+            key_pieces.append(jnp.where(score == 0, INT32_MAX, c_key))
+            kbest = jnp.argmax(score, axis=2)
+            sbest = jnp.take_along_axis(score, kbest[..., None], axis=2)[..., 0]
 
             # individual pending representative: lowest pending bit
-            pend_b = self._blk(pend, l)
+            pend_b = self._blocks(pend, b)
             has_pend = popcount_words(pend_b) > 0
             m_ind = self._lowest_bit(pend_b)
-            oh = self._onehot(m_ind & (bs - 1), self.w[l])
-            s_ind = self._eval_sig(l, oh[:, None, :], ver_b, indiv_b)[:, 0]
+            oh = self._onehot(m_ind & (bs[None, :] - 1), b.w_pad)
+            s_ind = self._eval_sig(
+                oh, ver_b, indiv_b, bs[None, :], lv[None, :]
+            )
             s_ind = jnp.where(has_pend, s_ind, -1)
             # worthless individuals are dropped too
-            pend = self._blk_write(
-                pend, l, jnp.where((s_ind == 0)[:, None], pend_b & ~oh, pend_b),
-                has_pend & (s_ind == 0),
+            pend_pieces.append(
+                jnp.where(
+                    (has_pend & (s_ind == 0))[..., None], pend_b & ~oh, pend_b
+                )
             )
 
             use_ind = s_ind > sbest
-            l_score = jnp.maximum(sbest, s_ind)
-            l_rel = jnp.where(
-                use_ind,
-                bs + (m_ind & (bs - 1)),
-                jnp.take_along_axis(c_key, kbest[:, None], axis=1)[:, 0],
+            score_p.append(jnp.maximum(sbest, s_ind))
+            rel_p.append(
+                jnp.where(
+                    use_ind,
+                    bs[None, :] + (m_ind & (bs[None, :] - 1)),
+                    jnp.take_along_axis(c_key, kbest[..., None], axis=2)[..., 0],
+                )
             )
-            l_pk = jnp.where(
-                use_ind, 0, jnp.take_along_axis(c_pk, kbest[:, None], axis=1)[:, 0]
+            pk_p.append(
+                jnp.where(
+                    use_ind,
+                    0,
+                    jnp.take_along_axis(c_pk, kbest[..., None], axis=2)[..., 0],
+                )
             )
-            l_kidx = jnp.where(use_ind, -1, kbest)
+            kidx_p.append(jnp.where(use_ind, -1, kbest))
 
-            better = l_score > best_score
-            best_score = jnp.where(better, l_score, best_score)
-            best_level = jnp.where(better, l, best_level)
-            best_rel = jnp.where(better, l_rel, best_rel)
-            best_pk = jnp.where(better, l_pk, best_pk)
-            best_kidx = jnp.where(better, l_kidx, best_kidx)
+        l_score = self._level_stats(score_p)  # [N, L-1]
+        l_rel = self._level_stats(rel_p)
+        l_pk = self._level_stats(pk_p)
+        l_kidx = self._level_stats(kidx_p)
+        pend = self._assemble(pend, pend_pieces)
+        new_cand_key = jnp.concatenate(key_pieces, axis=1).reshape(n, (L - 1) * K)
+
+        # global best across levels; ascending-level iteration with strict >
+        # in the original = first maximum wins = argmax
+        lidx = jnp.argmax(l_score, axis=1)
+        best_score = jnp.take_along_axis(l_score, lidx[:, None], axis=1)[:, 0]
+        best_level = (lidx + 1).astype(jnp.int32)
+        best_rel = jnp.take_along_axis(l_rel, lidx[:, None], axis=1)[:, 0]
+        best_pk = jnp.take_along_axis(l_pk, lidx[:, None], axis=1)[:, 0]
+        best_kidx = jnp.take_along_axis(l_kidx, lidx[:, None], axis=1)[:, 0]
 
         can = free & (best_score > 0)
         sel_single = best_kidx < 0
 
         # load the chosen sig into the verification register
+        bs_sel = jnp.asarray(self.lv_bs)[jnp.maximum(best_level - 1, 0)]
         ver_sig = proto["ver_sig"]
-        for l in range(1, L):
-            bs = 1 << (l - 1)
-            m = can & (best_level == l)
-            c_sig = self._sig_seg(proto["cand_sig"], l, K)
+        for i, b in enumerate(self.buckets):
+            m = can & (best_level >= b.lo) & (best_level <= b.hi)
+            c_sig = self._sig_view(proto, i, K, prefix="cand_sig")
+            li = jnp.clip(best_level - b.lo, 0, b.nl - 1)
+            c_lv = jnp.take_along_axis(c_sig, li[:, None, None, None], axis=1)[:, 0]
             safe_k = jnp.maximum(best_kidx, 0)
-            from_buf = jnp.take_along_axis(c_sig, safe_k[:, None, None], axis=1)[:, 0]
-            single = self._onehot(best_rel & (bs - 1), self.w[l])
+            from_buf = jnp.take_along_axis(c_lv, safe_k[:, None, None], axis=1)[:, 0]
+            single = self._onehot(best_rel & (bs_sel - 1), b.w_pad)
             sig_l = jnp.where(sel_single[:, None], single, from_buf)
-            pad = jnp.zeros((n, self.w_max - self.w[l]), jnp.uint32)
+            pad = jnp.zeros((n, self.w_max - b.w_pad), jnp.uint32)
             ver_sig = jnp.where(
                 m[:, None], jnp.concatenate([sig_l, pad], axis=1), ver_sig
             )
-            # clear the individual pending bit on selection
-            pend_b = self._blk(pend, l)
-            oh = self._onehot(best_rel & (bs - 1), self.w[l])
-            pend = self._blk_write(pend, l, pend_b & ~oh, m & sel_single)
+
+        # clear the individual pending bit on selection (bit best_rel of the
+        # full-width rel-space vector)
+        oh_full = self._onehot(best_rel, self.n_words)
+        pend = jnp.where((can & sel_single)[:, None], pend & ~oh_full, pend)
 
         # remove the chosen buffer candidate
         flat_idx = (best_level - 1) * K + jnp.maximum(best_kidx, 0)

@@ -8,21 +8,22 @@ Handel.java:634-647, becomes a static mask), and re-addressing a level-l
 contribution from sender s into receiver i's space is the bit permutation
 j -> j ^ r0 with r0 = (i^s) & (2^(l-1)-1).
 
-Memory layout (what makes 4096 nodes x 32 replicas fit in HBM): level l's
-outgoing content is only bits [0, 2^(l-1)) — w_l = max(1, 2^(l-1)/32)
-words — so all per-level buffers are packed into ONE flat word axis of
-W_total = sum_l w_l words (132 for n=4096) instead of a uniform
-[L, n_words/2] block (6.3x smaller, and it avoids XLA's (8,128) tile
-padding on small minor dimensions).
+Program-size layout (what makes the 4096-node program compile): levels
+are grouped into WIDTH BUCKETS (BitsetAggBase) and every phase runs once
+per bucket on a stacked [N, nl, ...] level axis instead of once per
+level; the per-level dissemination/fastPath send calls collapse into one
+stacked send over [N * levels] rows.  Channel and candidate content are
+flat per-bucket 2D arrays, so nothing pays XLA's (8,128) tile padding.
 
 Three buffer stages per (receiver, level), mirroring the reference's
 message + toVerifyAgg + pairing pipeline:
 
   1. in-flight channel: D slots keyed by ((arrival-now)<<rel_bits | rel),
      slot = arrival mod D, earliest arrival wins; displaced sends are
-     lost — Handel's periodic dissemination re-offers content every
-     period, exactly the redundancy the reference relies on for its own
-     dropped/filtered messages.  Content is stored in SENDER bit space.
+     counted in proto["displaced"] and lost — Handel's periodic
+     dissemination re-offers content every period, exactly the redundancy
+     the reference relies on for its own dropped/filtered messages.
+     Content is stored in SENDER bit space.
   2. candidate buffer (toVerifyAgg, Handel.java:447): K slots of arrived,
      not-yet-verified aggregate sigs in receiver block-local space,
      curated exactly like bestToVerify's pruning — a candidate survives
@@ -118,21 +119,31 @@ class BatchedHandel(BitsetAggBase):
         return 1 + expected // 8 + 96 * 2
 
     # -- ranks ---------------------------------------------------------------
-    def _base_rank(self, seed, ids, l: int, rel):
+    def _rank(self, seed, ids, level, rel):
         """Counter-hash stand-in for the reference's global reception-rank
         permutation (setReceivingRanks, Handel.java:940-948): a bijection
         over the level block scaled to the [0, N) range so windowIndex +
-        currWindowSize comparisons see reference-like rank spacing."""
-        bs = 1 << (l - 1)
+        currWindowSize comparisons see reference-like rank spacing.
+
+        ids/level/rel broadcast together; level may be a static int or a
+        stacked [.., L-1, ..] axis."""
+        level = jnp.asarray(level, jnp.int32)
+        bs = jnp.asarray(self.lv_bs)[level - 1]
         r0 = rel & (bs - 1)
-        mul = hash32(seed, ids, jnp.int32(l), jnp.int32(0xA11CE)) | jnp.int32(1)
-        add = hash32(seed, ids, jnp.int32(l), jnp.int32(0xBEEF))
+        mul = hash32(seed, ids, level, jnp.int32(0xA11CE)) | jnp.int32(1)
+        add = hash32(seed, ids, level, jnp.int32(0xBEEF))
         perm = (r0 * mul + add) & (bs - 1)
-        gap = self.n_nodes // bs
-        if gap > 1:
-            jit = hash32(seed, ids, rel, jnp.int32(l)) & jnp.int32(gap - 1)
-            return perm * gap + jit
-        return perm
+        gap = jnp.int32(self.n_nodes) // bs  # >= 2 for every level
+        jit = hash32(seed, ids, rel, level) & (gap - 1)
+        return perm * gap + jit
+
+    def _dyn_full_block(self, bs, w_pad: int):
+        """[..,] dynamic block sizes -> [.., w_pad] all-ones-below-bs words."""
+        bits = jnp.clip(
+            bs[..., None] - 32 * jnp.arange(w_pad, dtype=jnp.int32), 0, 32
+        )
+        m = (jnp.uint32(1) << (bits & 31).astype(jnp.uint32)) - 1
+        return jnp.where(bits >= 32, jnp.uint32(0xFFFFFFFF), m)
 
     # -- state ---------------------------------------------------------------
     def proto_init(
@@ -142,12 +153,16 @@ class BatchedHandel(BitsetAggBase):
         start_at: np.ndarray,
         byz_rel: Optional[np.ndarray] = None,
     ):
-        n, L, D, K = self.n_nodes, self.n_levels, self.CHANNEL_DEPTH, self.CAND_SLOTS
+        n, L, K = self.n_nodes, self.n_levels, self.CAND_SLOTS
         own = np.zeros((n, self.n_words), dtype=np.uint32)
         own[:, 0] = 1  # bit 0 = own signature (level 0)
         if byz_rel is None:
             byz_rel = np.zeros((n, self.n_words), dtype=np.uint32)
-        in_key, in_sig = self._channel_init(n)
+        in_key, in_sigs = self._channel_init(n)
+        cand_sigs = {
+            f"cand_sig{i}": jnp.zeros((n, b.nl * K * b.w_pad), jnp.uint32)
+            for i, b in enumerate(self.buckets)
+        }
         return {
             "agg": jnp.asarray(own),  # lastAggVerified per level block
             "ind": jnp.asarray(own),  # verifiedIndSignatures
@@ -157,11 +172,12 @@ class BatchedHandel(BitsetAggBase):
             # stage 1: in-flight channel (D arrival slots + 1 fresh backstop
             # per level; see BitsetAggBase)
             "in_key": in_key,
-            "in_sig": in_sig,
+            **in_sigs,
+            "displaced": jnp.int32(0),
             # stage 2: candidate buffer (toVerifyAgg)
             "cand_rank": jnp.full((n, (L - 1) * K), INT32_MAX, jnp.int32),
             "cand_rel": jnp.zeros((n, (L - 1) * K), jnp.int32),
-            "cand_sig": jnp.zeros((n, K * self.w_total), jnp.uint32),
+            **cand_sigs,
             # stage 3: verification register
             "ver_active": jnp.zeros(n, bool),
             "ver_done_t": jnp.zeros(n, jnp.int32),
@@ -181,7 +197,7 @@ class BatchedHandel(BitsetAggBase):
     # -- tick phase 1: commit due verifications ------------------------------
     def _commit(self, net, state):
         """updateVerifiedSignatures at t = selection + pairingTime
-        (Handel.java:686-750)."""
+        (Handel.java:686-750), one stacked body per width bucket."""
         p = self.params
         proto = state.proto
         t = state.time
@@ -198,17 +214,20 @@ class BatchedHandel(BitsetAggBase):
         new_bl = jnp.where(bad[:, None], proto["bl"] | oh_full, proto["bl"])
 
         agg, ind, inc = proto["agg"], proto["ind"], proto["inc"]
+        lvl = proto["ver_level"]
         improved_any = jnp.zeros(n, bool)
         just_completed = jnp.zeros(n, bool)
-        for l in range(1, L):
-            m = good & (proto["ver_level"] == l)
-            bs = 1 << (l - 1)
-            r0 = rel & (bs - 1)
-            sig_b = proto["ver_sig"][:, : self.w[l]]
-            ind_b = self._blk(ind, l)
-            agg_b = self._blk(agg, l)
-            inc_b = self._blk(inc, l)
-            sender = self._onehot(r0, self.w[l])
+        ind_pieces, agg_pieces, inc_pieces = [], [], []
+        for i, b in enumerate(self.buckets):
+            lv = jnp.asarray(b.levels, jnp.int32)
+            bs = jnp.asarray([self.bs[l] for l in b.levels], jnp.int32)
+            m = good[:, None] & (lvl[:, None] == lv[None, :])  # [N, nl]
+            r0 = rel[:, None] & (bs[None, :] - 1)
+            sig_b = proto["ver_sig"][:, None, : b.w_pad]  # zero above w[lvl]
+            ind_b = self._blocks(ind, b)  # [N, nl, w_pad]
+            agg_b = self._blocks(agg, b)
+            inc_b = self._blocks(inc, b)
+            sender = self._onehot(r0, b.w_pad)
 
             new_ind_b = ind_b | sender
             # the improved guard: extend/replace lastAgg ONLY when the
@@ -216,24 +235,32 @@ class BatchedHandel(BitsetAggBase):
             improved2 = popcount_words(sig_b | new_ind_b) > popcount_words(new_ind_b)
             inter = popcount_words(agg_b & sig_b) > 0
             new_agg_b = jnp.where(
-                (improved2 & inter)[:, None], sig_b, agg_b | jnp.where(
-                    improved2[:, None], sig_b, jnp.uint32(0)
-                )
+                (improved2 & inter)[..., None],
+                jnp.broadcast_to(sig_b, agg_b.shape),
+                agg_b | jnp.where(improved2[..., None], sig_b, jnp.uint32(0)),
             )
             new_inc_b = jnp.where(
-                improved2[:, None], new_agg_b | new_ind_b, inc_b | sender
+                improved2[..., None], new_agg_b | new_ind_b, inc_b | sender
             )
             improved1 = popcount_words(inc_b & sender) == 0
             improved = m & (improved1 | improved2)
 
-            before_full = popcount_words(inc_b) == bs
-            after_full = popcount_words(new_inc_b) == bs
-            just_completed = just_completed | (improved & after_full & ~before_full)
-            improved_any = improved_any | improved
+            before_full = popcount_words(inc_b) == bs[None, :]
+            after_full = popcount_words(new_inc_b) == bs[None, :]
+            just_completed = just_completed | jnp.any(
+                improved & after_full & ~before_full, axis=1
+            )
+            improved_any = improved_any | jnp.any(improved, axis=1)
 
-            ind = self._blk_write(ind, l, new_ind_b, m)
-            agg = self._blk_write(agg, l, new_agg_b, m & improved2)
-            inc = self._blk_write(inc, l, new_inc_b, m)
+            ind_pieces.append(jnp.where(m[..., None], new_ind_b, ind_b))
+            agg_pieces.append(
+                jnp.where((m & improved2)[..., None], new_agg_b, agg_b)
+            )
+            inc_pieces.append(jnp.where(m[..., None], new_inc_b, inc_b))
+
+        ind = self._assemble(ind, ind_pieces)
+        agg = self._assemble(agg, agg_pieces)
+        inc = self._assemble(inc, inc_pieces)
 
         total = popcount_words(inc)
         done_now = (
@@ -255,42 +282,44 @@ class BatchedHandel(BitsetAggBase):
         # contact fast_path peers of the first higher level whose outgoing
         # is now complete but whose incoming is not
         if p.fast_path > 0 and L > 1:
-            out_done = jnp.stack(
+            out_done = self._level_stats(
                 [
-                    popcount_words(self._low(inc, l)) == (1 if l == 1 else 1 << (l - 1))
-                    for l in range(1, L)
-                ],
-                axis=1,
+                    popcount_words(self._lows(inc, b))
+                    == jnp.asarray([self.bs[l] for l in b.levels], jnp.int32)[None, :]
+                    for b in self.buckets
+                ]
             )
-            inc_done = jnp.stack(
+            inc_done = self._level_stats(
                 [
-                    popcount_words(self._blk(inc, l)) == (1 << (l - 1))
-                    for l in range(1, L)
-                ],
-                axis=1,
+                    popcount_words(self._blocks(inc, b))
+                    == jnp.asarray([self.bs[l] for l in b.levels], jnp.int32)[None, :]
+                    for b in self.buckets
+                ]
             )
-            target_ok = out_done & ~inc_done
+            target_ok = out_done & ~inc_done  # [N, L-1]
             has_target = jnp.any(target_ok, axis=1)
             lsel = (jnp.argmax(target_ok, axis=1) + 1).astype(jnp.int32)
             fp_mask_base = just_completed & has_target
             fp = min(p.fast_path, max(1, self.n_nodes // 2))
+            bs_sel = jnp.asarray(self.lv_bs)[jnp.maximum(lsel - 1, 0)]
             ks = jnp.arange(fp, dtype=jnp.int32)
             offset = hash32(state.seed, ids, lsel, t)
-            for l in range(1, L):
-                bs = 1 << (l - 1)
-                fpl = min(fp, bs)
-                m = fp_mask_base & (lsel == l)
-                rel_fp = bs + ((offset[:, None] + ks[None, :fpl]) & (bs - 1))
-                content = self._low(inc, l)
-                state = self._send_level(
-                    net,
-                    state,
-                    l,
-                    jnp.repeat(m, fpl),
-                    jnp.repeat(ids, fpl),
-                    (ids[:, None] ^ rel_fp).reshape(-1),
-                    jnp.repeat(content, fpl, axis=0),
-                )
+            # row (i, k): valid while k < min(fp, 2^(lsel-1))
+            m_rows = fp_mask_base[:, None] & (ks[None, :] < bs_sel[:, None])
+            rel_fp = bs_sel[:, None] + ((offset[:, None] + ks[None, :]) & (bs_sel[:, None] - 1))
+            content = [
+                jnp.repeat(self._dyn_low(inc, lsel, b), fp, axis=0)
+                for b in self.buckets
+            ]
+            state = self._send_stacked(
+                net,
+                state,
+                m_rows.reshape(-1),
+                jnp.repeat(ids, fp),
+                (ids[:, None] ^ rel_fp).reshape(-1),
+                jnp.repeat(lsel, fp),
+                content,
+            )
         return state
 
     # -- tick phase 2: deliver due channel slots into the candidate buffer ---
@@ -303,61 +332,65 @@ class BatchedHandel(BitsetAggBase):
         n, L, D, K = self.n_nodes, self.n_levels, self.CHANNEL_DEPTH, self.CAND_SLOTS
         ids = jnp.arange(n, dtype=jnp.int32)
         rel_mask = (1 << self.rel_bits) - 1
-
         ss = D + 1
+        lv_all = jnp.arange(1, L, dtype=jnp.int32)  # [L-1]
+
         in_key, due_all, empty_tpl = self._advance_channel(proto["in_key"])
 
-        # (receiver traffic counters tick at send time in _send_level)
+        # (receiver traffic counters tick at send time in _send_stacked)
         d_by_level = due_all.reshape(n, L - 1, ss)
         started = t >= proto["start_at"]
         not_done = state.done_at == 0
-        filtered = jnp.sum((d_by_level & ~not_done[:, None, None]).astype(jnp.int32), axis=(1, 2))
+        filtered = jnp.sum(
+            (d_by_level & ~not_done[:, None, None]).astype(jnp.int32), axis=(1, 2)
+        )
 
-        new_cand_rank = proto["cand_rank"]
-        new_cand_rel = proto["cand_rel"]
-        new_cand_sig = proto["cand_sig"]
+        keys3 = self._keys_stacked(in_key)  # [N, L-1, ss]
+        due3 = due_all.reshape(n, L - 1, ss)
+        rel3 = keys3 & rel_mask
+
+        # onNewSig drop filters: not started, done, blacklisted sender
+        bl_bit = self._getbit(proto["bl"], rel3)
+        accept = due3 & started[:, None, None] & not_done[:, None, None] & (bl_bit == 0)
+
+        # rank + verified-sender demotion (receptionRanks += nodeCount)
+        ind_bit = self._getbit(proto["ind"], rel3)
+        rank3 = self._rank(
+            state.seed, ids[:, None, None], lv_all[None, :, None], rel3
+        ) + self.n_nodes * ind_bit.astype(jnp.int32)
+        rank3 = jnp.where(accept, rank3, INT32_MAX)
+
         inc, ind, bl = proto["inc"], proto["ind"], proto["bl"]
+        rank_pieces, rel_pieces = [], []
+        cand_sig_updates = {}
+        for i, b in enumerate(self.buckets):
+            sl = slice(b.lo - 1, b.hi)  # level rows of this bucket
+            bs = jnp.asarray([self.bs[l] for l in b.levels], jnp.int32)
+            r0 = rel3[:, sl, :] & (bs[None, :, None] - 1)
+            sig_new = self._arrived_blocks(proto, i, r0)  # [N, nl, ss, w_pad]
+            rank_new = rank3[:, sl, :]
+            rel_new = rel3[:, sl, :]
 
-        for l in range(1, L):
-            bs = 1 << (l - 1)
-            w = self.w[l]
-            keys = self._key_seg(in_key, l)  # [N, D]
-            due = self._key_seg(due_all, l)
-            rel = keys & rel_mask
-            r0 = rel & (bs - 1)
+            # merge [K existing + ss new], keep top-K by (sizeIfIncluded, -rank)
+            c_rank = proto["cand_rank"].reshape(n, L - 1, K)[:, sl, :]
+            c_rel = proto["cand_rel"].reshape(n, L - 1, K)[:, sl, :]
+            c_sig = self._sig_view(proto, i, K, prefix="cand_sig")
 
-            # onNewSig drop filters: not started, done, blacklisted sender
-            bl_bit = self._getbit(bl, rel)
-            accept = due & started[:, None] & not_done[:, None] & (bl_bit == 0)
-
-            # shuffle sender-space content into receiver block-local space
-            sig_new = xor_shuffle(self._sig_seg(proto["in_sig"], l, ss), r0)
-
-            # rank + verified-sender demotion (receptionRanks += nodeCount)
-            ind_bit = self._getbit(ind, rel)
-            rank_new = self._base_rank(
-                state.seed, ids[:, None], l, rel
-            ) + self.n_nodes * ind_bit.astype(jnp.int32)
-            rank_new = jnp.where(accept, rank_new, INT32_MAX)
-
-            # merge [K existing + D new], keep top-K by (sizeIfIncluded, -rank)
-            c_rank = proto["cand_rank"][:, (l - 1) * K : l * K]
-            c_rel = proto["cand_rel"][:, (l - 1) * K : l * K]
-            c_sig = self._sig_seg(proto["cand_sig"], l, K)
-
-            all_rank = jnp.concatenate([c_rank, rank_new], axis=1)  # [N, K+D]
-            all_rel = jnp.concatenate([c_rel, rel], axis=1)
-            all_sig = jnp.concatenate([c_sig, sig_new], axis=1)  # [N, K+D, w]
+            all_rank = jnp.concatenate([c_rank, rank_new], axis=2)  # [N, nl, K+ss]
+            all_rel = jnp.concatenate([c_rel, rel_new], axis=2)
+            all_sig = jnp.concatenate([c_sig, sig_new], axis=2)
             valid = all_rank != INT32_MAX
 
-            inc_b = self._blk(inc, l)
-            ind_b = self._blk(ind, l)
-            inter = popcount_words(all_sig & inc_b[:, None, :]) > 0
-            c = jnp.where(inter[..., None], all_sig, all_sig | inc_b[:, None, :])
-            s = popcount_words(c | ind_b[:, None, :])  # sizeIfIncluded
+            inc_b = self._blocks(inc, b)  # [N, nl, w_pad]
+            ind_b = self._blocks(ind, b)
+            inter = popcount_words(all_sig & inc_b[:, :, None, :]) > 0
+            c = jnp.where(
+                inter[..., None], all_sig, all_sig | inc_b[:, :, None, :]
+            )
+            s = popcount_words(c | ind_b[:, :, None, :])  # sizeIfIncluded
             cur = popcount_words(inc_b)
             bl_all = self._getbit(bl, all_rel)
-            keep = valid & (s > cur[:, None]) & (bl_all == 0)
+            keep = valid & (s > cur[:, :, None]) & (bl_all == 0)
 
             # sort key: higher sizeIfIncluded first, then lower rank;
             # bounded (s <= bs <= N/2, rank < 3N) so s*4N + rank fits int32
@@ -365,40 +398,43 @@ class BatchedHandel(BitsetAggBase):
             skey = jnp.where(
                 keep, s * r4 + (r4 - 1 - jnp.minimum(all_rank, r4 - 1)), -1
             )
-            order = jnp.argsort(-skey, axis=1)[:, :K]  # top-K
-            top_keep = jnp.take_along_axis(skey, order, axis=1) >= 0
+            order = jnp.argsort(-skey, axis=2)[:, :, :K]  # top-K
+            top_keep = jnp.take_along_axis(skey, order, axis=2) >= 0
             sel_rank = jnp.where(
-                top_keep, jnp.take_along_axis(all_rank, order, axis=1), INT32_MAX
+                top_keep, jnp.take_along_axis(all_rank, order, axis=2), INT32_MAX
             )
-            sel_rel = jnp.take_along_axis(all_rel, order, axis=1)
-            sel_sig = jnp.take_along_axis(all_sig, order[..., None], axis=1)
+            sel_rel = jnp.take_along_axis(all_rel, order, axis=2)
+            sel_sig = jnp.take_along_axis(all_sig, order[..., None], axis=2)
 
-            new_cand_rank = new_cand_rank.at[:, (l - 1) * K : l * K].set(sel_rank)
-            new_cand_rel = new_cand_rel.at[:, (l - 1) * K : l * K].set(sel_rel)
-            o, wk = self.off[l] * K, self.w[l] * K
-            new_cand_sig = new_cand_sig.at[:, o : o + wk].set(
-                sel_sig.reshape(n, wk)
+            rank_pieces.append(sel_rank)
+            rel_pieces.append(sel_rel)
+            cand_sig_updates[f"cand_sig{i}"] = sel_sig.reshape(
+                n, b.nl * K * b.w_pad
             )
 
         state = state._replace(
             proto=dict(
                 proto,
                 in_key=jnp.where(due_all, empty_tpl[None, :], in_key),
-                cand_rank=new_cand_rank,
-                cand_rel=new_cand_rel,
-                cand_sig=new_cand_sig,
+                cand_rank=jnp.concatenate(rank_pieces, axis=1).reshape(n, (L - 1) * K),
+                cand_rel=jnp.concatenate(rel_pieces, axis=1).reshape(n, (L - 1) * K),
                 msg_filtered=proto["msg_filtered"] + filtered,
+                **cand_sig_updates,
             )
         )
         return state
 
     # -- tick phase 3: periodic dissemination --------------------------------
     def _dissemination(self, net, state):
-        """Periodic doCycle over open levels (Handel.java:331-343, 452-480)."""
+        """Periodic doCycle over open levels (Handel.java:331-343, 452-480),
+        all levels in ONE stacked send."""
         p = self.params
         proto = state.proto
         t = state.time
-        ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
+        n, L = self.n_nodes, self.n_levels
+        ids = jnp.arange(n, dtype=jnp.int32)
+        lv_all = jnp.arange(1, L, dtype=jnp.int32)
+        bs_all = jnp.asarray(self.lv_bs)
 
         start = proto["start_at"] + 1
         on_beat = (t >= start) & (
@@ -411,22 +447,46 @@ class BatchedHandel(BitsetAggBase):
             proto["added_cycle"] - 1,
             proto["added_cycle"],
         )
-        new_pos = proto["pos"]
-        state = state._replace(proto=dict(proto, added_cycle=new_added))
 
-        for l in range(1, self.n_levels):
-            bs = 1 << (l - 1)
-            opened = t >= (l - 1) * p.level_wait_time
-            out_b = self._low(state.proto["inc"], l)
-            complete = popcount_words(out_b) == (1 if l == 1 else bs)
-            mask = may_send & (opened | complete)
-            offset = hash32(state.seed, ids, jnp.int32(l)) & (bs - 1)
-            rel = (bs + ((new_pos[:, l] + offset) & (bs - 1))).astype(jnp.int32)
-            new_pos = new_pos.at[:, l].set(
-                jnp.where(mask, new_pos[:, l] + 1, new_pos[:, l])
-            )
-            state = self._send_level(net, state, l, mask, ids, ids ^ rel, out_b)
-        state = state._replace(proto=dict(state.proto, pos=new_pos))
+        inc = proto["inc"]
+        opened = t >= (lv_all - 1) * jnp.int32(p.level_wait_time)  # [L-1]
+        complete = self._level_stats(
+            [
+                popcount_words(self._lows(inc, b))
+                == jnp.asarray([self.bs[l] for l in b.levels], jnp.int32)[None, :]
+                for b in self.buckets
+            ]
+        )
+        mask = may_send[:, None] & (opened[None, :] | complete)  # [N, L-1]
+
+        offset = hash32(state.seed, ids[:, None], lv_all[None, :]) & (bs_all[None, :] - 1)
+        pos = proto["pos"][:, 1:]
+        rel = (bs_all[None, :] + ((pos + offset) & (bs_all[None, :] - 1))).astype(
+            jnp.int32
+        )
+        new_pos = proto["pos"].at[:, 1:].set(jnp.where(mask, pos + 1, pos))
+        state = state._replace(
+            proto=dict(proto, added_cycle=new_added, pos=new_pos)
+        )
+
+        # content: each level sends its outgoing prefix (zeros for levels
+        # outside a bucket — those rows are masked in the scatter)
+        content = []
+        for b in self.buckets:
+            lows = self._lows(inc, b)  # [N, nl, w_pad]
+            full = jnp.zeros((n, L - 1, b.w_pad), jnp.uint32)
+            full = full.at[:, b.lo - 1 : b.hi, :].set(lows)
+            content.append(full.reshape(n * (L - 1), b.w_pad))
+
+        state = self._send_stacked(
+            net,
+            state,
+            mask.reshape(-1),
+            jnp.repeat(ids, L - 1),
+            (ids[:, None] ^ rel).reshape(-1),
+            jnp.broadcast_to(lv_all[None, :], (n, L - 1)).reshape(-1),
+            content,
+        )
         return state
 
     # -- tick phase 4: start new verifications (checkSigs) -------------------
@@ -439,11 +499,7 @@ class BatchedHandel(BitsetAggBase):
         n, L, K = self.n_nodes, self.n_levels, self.CAND_SLOTS
         ids = jnp.arange(n, dtype=jnp.int32)
 
-        free = (
-            ~proto["ver_active"]
-            & ~state.down
-            & (t >= proto["start_at"] + 1)
-        )
+        free = ~proto["ver_active"] & ~state.down & (t >= proto["start_at"] + 1)
         window = proto["window"]
         inc, ind, agg, bl, byz = (
             proto["inc"],
@@ -453,116 +509,113 @@ class BatchedHandel(BitsetAggBase):
             proto["byz"],
         )
 
-        # per-level bests
-        has = []  # level has a candidate to verify
-        b_rank = []  # chosen candidate's rank (for hidden-byz comparison)
-        b_rel = []
-        b_bad = []
-        b_kidx = []  # candidate-buffer slot, -1 = injected
-        b_widx = []  # windowIndex per level (hidden-byz re-run needs it)
-        b_insc = []  # inside-window score of the choice, -1 = outside pick
-        new_cand_rank = proto["cand_rank"]
-        for l in range(1, L):
-            bs = 1 << (l - 1)
-            c_rank = proto["cand_rank"][:, (l - 1) * K : l * K]
-            c_rel = proto["cand_rel"][:, (l - 1) * K : l * K]
-            c_sig = self._sig_seg(proto["cand_sig"], l, K)
+        # per-level bests, one stacked body per bucket
+        has_p, b_rank_p, b_rel_p, b_bad_p, b_kidx_p = [], [], [], [], []
+        widx_p, insc_p = [], []
+        rank_pieces = []
+        for i, b in enumerate(self.buckets):
+            sl = slice(b.lo - 1, b.hi)
+            lv = jnp.asarray(b.levels, jnp.int32)
+            bs = jnp.asarray([self.bs[l] for l in b.levels], jnp.int32)
+            c_rank = proto["cand_rank"].reshape(n, L - 1, K)[:, sl, :]
+            c_rel = proto["cand_rel"].reshape(n, L - 1, K)[:, sl, :]
+            c_sig = self._sig_view(proto, i, K, prefix="cand_sig")
             valid = c_rank != INT32_MAX
 
-            inc_b = self._blk(inc, l)
-            ind_b = self._blk(ind, l)
-            agg_b = self._blk(agg, l)
+            inc_b = self._blocks(inc, b)
+            ind_b = self._blocks(ind, b)
+            agg_b = self._blocks(agg, b)
 
             # curation (bestToVerify :592-612): drop blacklisted senders and
             # candidates that can no longer grow the aggregate
-            inter = popcount_words(c_sig & inc_b[:, None, :]) > 0
-            cc = jnp.where(inter[..., None], c_sig, c_sig | inc_b[:, None, :])
-            s = popcount_words(cc | ind_b[:, None, :])
+            inter = popcount_words(c_sig & inc_b[:, :, None, :]) > 0
+            cc = jnp.where(inter[..., None], c_sig, c_sig | inc_b[:, :, None, :])
+            s = popcount_words(cc | ind_b[:, :, None, :])
             bl_bit = self._getbit(bl, c_rel)
-            curated = valid & (s > popcount_words(inc_b)[:, None]) & (bl_bit == 0)
+            curated = valid & (s > popcount_words(inc_b)[:, :, None]) & (bl_bit == 0)
             # permanent removal, like replaceToVerifyAgg (:612-618)
-            pruned_rank = jnp.where(curated, c_rank, INT32_MAX)
-            new_cand_rank = new_cand_rank.at[:, (l - 1) * K : l * K].set(pruned_rank)
+            rank_pieces.append(jnp.where(curated, c_rank, INT32_MAX))
 
             # windowIndex = min rank over the (pre-curation valid) queue
             window_index = jnp.min(
-                jnp.where(valid, c_rank, INT32_MAX), axis=1
-            )
+                jnp.where(valid, c_rank, INT32_MAX), axis=2
+            )  # [N, nl]
             win_hi = jnp.where(
-                window_index < INT32_MAX - window, window_index + window, INT32_MAX
+                window_index < INT32_MAX - window[:, None],
+                window_index + window[:, None],
+                INT32_MAX,
             )
-            inside = curated & (c_rank <= win_hi[:, None])
+            inside = curated & (c_rank <= win_hi[:, :, None])
 
             # score (:650-664)
-            agg_card = popcount_words(agg_b)
+            agg_card = popcount_words(agg_b)  # [N, nl]
             sig_card = popcount_words(c_sig)
-            agg_inter = popcount_words(c_sig & agg_b[:, None, :]) > 0
-            with_ind = popcount_words(c_sig | ind_b[:, None, :])
+            agg_inter = popcount_words(c_sig & agg_b[:, :, None, :]) > 0
+            with_ind = popcount_words(c_sig | ind_b[:, :, None, :])
             score = jnp.where(
-                agg_card[:, None] >= bs,
+                agg_card[:, :, None] >= bs[None, :, None],
                 0,
                 jnp.where(
                     ~agg_inter,
-                    agg_card[:, None] + sig_card,
-                    jnp.maximum(0, with_ind - agg_card[:, None]),
+                    agg_card[:, :, None] + sig_card,
+                    jnp.maximum(0, with_ind - agg_card[:, :, None]),
                 ),
             )
             in_score = jnp.where(inside & (score > 0), score, -1)
-            k_in = jnp.argmax(in_score, axis=1)
-            sc_in = jnp.take_along_axis(in_score, k_in[:, None], axis=1)[:, 0]
+            k_in = jnp.argmax(in_score, axis=2)
+            sc_in = jnp.take_along_axis(in_score, k_in[..., None], axis=2)[..., 0]
             exists_in = sc_in > 0
 
             out_rank = jnp.where(curated & ~inside, c_rank, INT32_MAX)
-            k_out = jnp.argmin(out_rank, axis=1)
-            rk_out = jnp.take_along_axis(out_rank, k_out[:, None], axis=1)[:, 0]
+            k_out = jnp.argmin(out_rank, axis=2)
+            rk_out = jnp.take_along_axis(out_rank, k_out[..., None], axis=2)[..., 0]
             exists_out = rk_out < INT32_MAX
 
             kidx = jnp.where(exists_in, k_in, k_out)
             lrank = jnp.where(
                 exists_in,
-                jnp.take_along_axis(c_rank, k_in[:, None], axis=1)[:, 0],
+                jnp.take_along_axis(c_rank, k_in[..., None], axis=2)[..., 0],
                 rk_out,
             )
-            lrel = jnp.take_along_axis(c_rel, kidx[:, None], axis=1)[:, 0]
+            lrel = jnp.take_along_axis(c_rel, kidx[..., None], axis=2)[..., 0]
             lhas = exists_in | exists_out
-            lbad = jnp.zeros(n, bool)
+            lbad = jnp.zeros((n, b.nl), bool)
 
             if p.byzantine_suicide:
                 # createSuicideByzantineSig (:538-559): a forged full-block
                 # sig from an eligible Byzantine peer short-circuits the
                 # level's choice.  Eligible = down+byz, not blacklisted,
                 # rank inside windowIndex + currWindowSize, queue non-empty.
-                eligible = self._blk(byz, l) & ~self._blk(bl, l)
-                any_valid = jnp.any(valid, axis=1)
+                eligible = self._blocks(byz, b) & ~self._blocks(bl, b)
+                any_valid = jnp.any(valid, axis=2)
                 has_byz = popcount_words(eligible) > 0
                 # lowest block-local index (stand-in for cursor order)
                 m_byz = self._lowest_bit(eligible)
-                rel_byz = bs + (m_byz & (bs - 1))
-                rank_byz = self._base_rank(state.seed, ids, l, rel_byz)
-                inject = (
-                    has_byz
-                    & any_valid
-                    & (rank_byz < win_hi)
+                rel_byz = bs[None, :] + (m_byz & (bs[None, :] - 1))
+                rank_byz = self._rank(
+                    state.seed, ids[:, None], lv[None, :], rel_byz
                 )
+                inject = has_byz & any_valid & (rank_byz < win_hi)
                 lhas = lhas | inject
                 lbad = jnp.where(inject, True, lbad)
                 lrel = jnp.where(inject, rel_byz, lrel)
                 lrank = jnp.where(inject, rank_byz, lrank)
                 kidx = jnp.where(inject, -1, kidx)
 
-            has.append(lhas)
-            b_rank.append(lrank)
-            b_rel.append(lrel)
-            b_bad.append(lbad)
-            b_kidx.append(kidx)
-            b_widx.append(window_index)
-            b_insc.append(jnp.where(exists_in, sc_in, -1))
+            has_p.append(lhas)
+            b_rank_p.append(lrank)
+            b_rel_p.append(lrel)
+            b_bad_p.append(lbad)
+            b_kidx_p.append(kidx)
+            widx_p.append(window_index)
+            insc_p.append(jnp.where(exists_in, sc_in, -1))
 
-        has = jnp.stack(has, axis=1)  # [N, L-1]
-        b_rank = jnp.stack(b_rank, axis=1)
-        b_rel = jnp.stack(b_rel, axis=1)
-        b_bad = jnp.stack(b_bad, axis=1)
-        b_kidx = jnp.stack(b_kidx, axis=1)
+        has = self._level_stats(has_p)  # [N, L-1]
+        b_rank = self._level_stats(b_rank_p)
+        b_rel = self._level_stats(b_rel_p)
+        b_bad = self._level_stats(b_bad_p)
+        b_kidx = self._level_stats(b_kidx_p)
+        new_cand_rank = jnp.concatenate(rank_pieces, axis=1).reshape(n, (L - 1) * K)
 
         # chooseBestFromLevels: uniform among levels with a candidate (:788)
         vcount = jnp.sum(has, axis=1).astype(jnp.int32)
@@ -591,19 +644,20 @@ class BatchedHandel(BitsetAggBase):
             # window with a strictly higher score than any inside candidate
             # (appended last, so ties keep the incumbent, :578-584).
             l = L - 1
-            bs = 1 << (l - 1)
-            inc_b = self._blk(inc, l)
-            ind_b = self._blk(ind, l)
-            agg_b = self._blk(agg, l)
-            eligible = self._blk(byz, l) & ~inc_b
+            bt = self.buckets[-1]
+            bs = self.bs[l]
+            inc_b = self._blocks(inc, bt)[:, -1]
+            ind_b = self._blocks(ind, bt)[:, -1]
+            agg_b = self._blocks(agg, bt)[:, -1]
+            eligible = self._blocks(byz, bt)[:, -1] & ~inc_b
             has_byz = popcount_words(eligible) > 0
             m_byz = self._lowest_bit(eligible)
             rel_byz = bs + (m_byz & (bs - 1))
-            rank_byz = self._base_rank(state.seed, ids, l, rel_byz)
+            rank_byz = self._rank(state.seed, ids, jnp.int32(l), rel_byz)
 
             # its score: single new bit (:650-664)
             agg_card = popcount_words(agg_b)
-            oh = self._onehot(m_byz & (bs - 1), self.w[l])
+            oh = self._onehot(m_byz & (bs - 1), bt.w_pad)
             byz_inter = popcount_words(oh & agg_b) > 0
             byz_score = jnp.where(
                 agg_card >= bs,
@@ -614,8 +668,8 @@ class BatchedHandel(BitsetAggBase):
                     jnp.maximum(0, popcount_words(oh | ind_b) - agg_card),
                 ),
             )
-            widx_top = b_widx[-1]
-            insc_top = b_insc[-1]
+            widx_top = self._level_stats(widx_p)[:, -1]
+            insc_top = self._level_stats(insc_p)[:, -1]
             new_widx = jnp.minimum(widx_top, rank_byz)
             win_hi = jnp.where(
                 new_widx < INT32_MAX - window, new_widx + window, INT32_MAX
@@ -642,29 +696,31 @@ class BatchedHandel(BitsetAggBase):
         shrunk = jnp.floor(window.astype(jnp.float32) / p.window_decrease_factor)
         adapted = jnp.where(sel_bad, shrunk, grown).astype(jnp.int32)
         adapted = jnp.clip(adapted, p.window_minimum, p.window_maximum)
-        lsize = (jnp.uint32(1) << jnp.maximum(level_sel - 1, 0).astype(jnp.uint32)).astype(
-            jnp.int32
-        )
+        lsize = (
+            jnp.uint32(1) << jnp.maximum(level_sel - 1, 0).astype(jnp.uint32)
+        ).astype(jnp.int32)
         new_window = jnp.where(can, jnp.minimum(adapted, lsize), window)
 
         # load the chosen sig into the verification register
+        bs_sel = jnp.asarray(self.lv_bs)[jnp.maximum(level_sel - 1, 0)]
         ver_sig = proto["ver_sig"]
-        for l in range(1, L):
-            bs = 1 << (l - 1)
-            m = can & (level_sel == l)
-            c_sig = self._sig_seg(proto["cand_sig"], l, K)
+        for i, b in enumerate(self.buckets):
+            m = can & (level_sel >= b.lo) & (level_sel <= b.hi)
+            c_sig = self._sig_view(proto, i, K, prefix="cand_sig")
+            li = jnp.clip(level_sel - b.lo, 0, b.nl - 1)
+            c_lv = jnp.take_along_axis(
+                c_sig, li[:, None, None, None], axis=1
+            )[:, 0]  # [N, K, w_pad]
             safe_k = jnp.maximum(sel_kidx, 0)
-            from_buf = jnp.take_along_axis(c_sig, safe_k[:, None, None], axis=1)[:, 0]
-            full_block = jnp.full((n, self.w[l]), 0xFFFFFFFF, jnp.uint32)
-            if bs < 32:
-                full_block = jnp.full((n, 1), (1 << bs) - 1, jnp.uint32)
-            single = self._onehot((sel_rel & (bs - 1)), self.w[l])
+            from_buf = jnp.take_along_axis(c_lv, safe_k[:, None, None], axis=1)[:, 0]
+            full_block = self._dyn_full_block(bs_sel, b.w_pad)
+            single = self._onehot(sel_rel & (bs_sel - 1), b.w_pad)
             sig_l = jnp.where(
                 (sel_kidx >= 0)[:, None],
                 from_buf,
                 jnp.where(sel_single[:, None], single, full_block),
             )
-            pad = jnp.zeros((n, self.w_max - self.w[l]), jnp.uint32)
+            pad = jnp.zeros((n, self.w_max - b.w_pad), jnp.uint32)
             sig_l = jnp.concatenate([sig_l, pad], axis=1)
             ver_sig = jnp.where(m[:, None], sig_l, ver_sig)
 
@@ -682,9 +738,7 @@ class BatchedHandel(BitsetAggBase):
                 proto,
                 cand_rank=new_cand_rank,
                 ver_active=jnp.where(can, True, proto["ver_active"]),
-                ver_done_t=jnp.where(
-                    can, t + proto["pairing"], proto["ver_done_t"]
-                ),
+                ver_done_t=jnp.where(can, t + proto["pairing"], proto["ver_done_t"]),
                 ver_level=jnp.where(can, level_sel, proto["ver_level"]),
                 ver_rel=jnp.where(can, sel_rel, proto["ver_rel"]),
                 ver_bad=jnp.where(can, sel_bad, proto["ver_bad"]),

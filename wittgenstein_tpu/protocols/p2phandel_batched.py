@@ -13,23 +13,33 @@ TPU-first design:
   * the periodic sendSigs beat picks argmax over per-peer diff
     cardinalities ([N, P] popcounts) and ships the diff bitset AS the
     message payload (PAYLOAD_WIDTH = N/32 words);
-  * checkSigs implements the default double-aggregate strategy
-    (checkSigs2, P2PHandel.java:455-479): the pending pool is a single
-    OR-aggregate, verified once per free verification register.  The
-    oracle can overlap two scheduled updates (it re-checks every
-    pairingTime while an update is in flight for 2*pairingTime); here a
-    new verification starts only when the register is free — worst case
-    one extra pairingTime of latency per batch, documented.
+  * checkSigs2 (the default double-aggregate strategy,
+    P2PHandel.java:455-479): the pending pool is a single OR-aggregate,
+    verified once per free verification register.  The oracle can
+    overlap two scheduled updates (it re-checks every pairingTime while
+    an update is in flight for 2*pairingTime); here a new verification
+    starts only when the register is free — worst case one extra
+    pairingTime of latency per batch, documented;
+  * checkSigs1 (double_aggregate_strategy=False, :419-447): the
+    to_verify pool is CAND_K distinct candidate bitsets [N, K, N]; the
+    beat prunes zero-value entries and verifies the one adding the most
+    signatures.  Same single-register policy as checkSigs2; same-ms
+    arrivals for one receiver merge into one pool entry (the oracle
+    keeps them distinct — single-arrival ms, the common case at the
+    default sigsSendPeriod, is exact);
+  * State broadcasts (send_state=True, :305-317 + init :497-501): every
+    node broadcasts its verified set to all peers at t=1 and on every
+    improving non-final commit; receivers fold it into peers_state only
+    (on_peer_state, :281-283).
 
 Engine-limit approximations: per-message wire sizes are dynamic in the
 reference (diff cardinality / range compression, :160-229) but the
 engine's traffic counters are per-type static — byte counters here use
-size 1 per SendSigs, so bytes stats are NOT comparable to the oracle
-(message counts are).  On the wire, "dif" ships the diff and all three
-other strategies ship the full verified set, exactly like the oracle's
-_create_send_sigs (:389-404) — the compressed variants only change the
-byte-size model, which is not modeled here.  checkSigs1 (single-best
-verification) and State broadcasts (send_state) are oracle-only.
+size 1 per SendSigs/State, so bytes stats are NOT comparable to the
+oracle (message counts are).  On the wire, "dif" ships the diff and all
+three other strategies ship the full verified set, exactly like the
+oracle's _create_send_sigs (:389-404) — the compressed variants only
+change the byte-size model, which is not modeled here.
 """
 
 from __future__ import annotations
@@ -47,8 +57,9 @@ from .p2phandel import P2PHandel, P2PHandelParameters
 
 
 class BatchedP2PHandel(BatchedProtocol):
-    MSG_TYPES = ["SEND_SIGS"]
+    MSG_TYPES = ["SEND_SIGS", "STATE"]
     TICK_INTERVAL = 1  # periodic beat + conditional checkSigs per ms
+    CAND_K = 8  # checkSigs1 to_verify pool depth
 
     def __init__(self, params: P2PHandelParameters, adjacency: np.ndarray, just_relay):
         self.params = params
@@ -82,7 +93,7 @@ class BatchedP2PHandel(BatchedProtocol):
         # signing nodes hold their own signature (ctor, :264-266)
         ids = jnp.arange(n)
         verified = verified.at[ids, ids].set(~self.just_relay)
-        return {
+        proto = {
             "verified": verified,
             "pend": jnp.zeros((n, n), bool),
             "peers_state": jnp.zeros((n, self.adj.shape[1], n), bool),
@@ -91,6 +102,28 @@ class BatchedP2PHandel(BatchedProtocol):
             "ver_sig": jnp.zeros((n, n), bool),
             "last_check": jnp.zeros(n, jnp.int32),
         }
+        if not self.params.double_aggregate_strategy:
+            proto["cand"] = jnp.zeros((n, self.CAND_K, n), bool)
+        return proto
+
+    def initial_emissions(self, net, state):
+        if not self.params.send_state:
+            return []
+        # init registers sendStateToPeers at t=1 for every node (:497-501)
+        n, n_peers = self.n_nodes, self.adj.shape[1]
+        ids = jnp.arange(n, dtype=jnp.int32)
+        return [
+            Emission(
+                mask=(self.adj >= 0).reshape(-1),
+                from_idx=jnp.repeat(ids, n_peers),
+                to_idx=jnp.maximum(self.adj, 0).reshape(-1),
+                mtype=self.mtype("STATE"),
+                payload=jnp.repeat(
+                    self._pack(state.proto["verified"]), n_peers, axis=0
+                ).reshape(n * n_peers, -1),
+                send_time=jnp.int32(1),
+            )
+        ]
 
     # -- message handling ----------------------------------------------------
     def deliver(self, net, state, deliver_mask):
@@ -99,16 +132,35 @@ class BatchedP2PHandel(BatchedProtocol):
         to, frm = state.msg_to, state.msg_from
         sigs = self._unpack(state.msg_payload)  # [C, N]
         sigs = sigs & deliver_mask[:, None]
+        is_ss = deliver_mask & (state.msg_type == self.mtype("SEND_SIGS"))
 
-        # peers_state[to, slot(frm)] |= sigs ; pend[to] |= sigs
-        # (onNewSig, :330-334)
+        # peers_state[to, slot(frm)] |= sigs — both SendSigs (onNewSig,
+        # :330-334) and State (onPeerState, :281-283) fold in here
         slot_of = jnp.argmax(self.adj[to] == frm[:, None], axis=1)
         ok = jnp.take_along_axis(self.adj[to], slot_of[:, None], axis=1)[:, 0] == frm
         w_to = jnp.where(deliver_mask & ok, to, n)
         proto["peers_state"] = proto["peers_state"].at[w_to, slot_of].max(
             sigs, mode="drop"
         )
-        proto["pend"] = proto["pend"].at[w_to].max(sigs, mode="drop")
+        ss_to = jnp.where(is_ss & ok, to, n)
+        if self.params.double_aggregate_strategy:
+            # checkSigs2 pool: one OR-aggregate
+            proto["pend"] = proto["pend"].at[ss_to].max(sigs, mode="drop")
+        else:
+            # checkSigs1 pool: same-ms arrivals merge into ONE new entry,
+            # which replaces the least-valuable slot if it adds more
+            arrivals = jnp.zeros((n, n), bool).at[ss_to].max(sigs, mode="drop")
+            has_new = jnp.any(arrivals, axis=1)
+            cand = proto["cand"]
+            verified = proto["verified"]
+            v_k = jnp.sum(cand & ~verified[:, None, :], axis=2)  # [N, K]
+            worst = jnp.argmin(v_k, axis=1)
+            v_min = jnp.take_along_axis(v_k, worst[:, None], axis=1)[:, 0]
+            v_new = jnp.sum(arrivals & ~verified, axis=1)
+            insert = has_new & (v_new > v_min)
+            proto["cand"] = cand.at[
+                jnp.where(insert, jnp.arange(n), n), worst
+            ].set(arrivals, mode="drop")
         return state._replace(proto=proto), []
 
     # -- per-tick ------------------------------------------------------------
@@ -127,7 +179,8 @@ class BatchedP2PHandel(BatchedProtocol):
         verified = jnp.where(due[:, None], verified | proto["ver_sig"], verified)
         new_card = jnp.sum(verified, axis=1)
         grew = due & (new_card > old_card)
-        reach = grew & (state.done_at == 0) & (new_card >= p.threshold)
+        was_undone = state.done_at == 0
+        reach = grew & was_undone & (new_card >= p.threshold)
         state = state._replace(done_at=jnp.where(reach, t, state.done_at))
         proto["ver_active"] = proto["ver_active"] & ~due
 
@@ -146,26 +199,64 @@ class BatchedP2PHandel(BatchedProtocol):
                 self._pack(verified), n_peers, axis=0
             ).reshape(n * n_peers, -1),
         )
+        em_state = None
+        if p.send_state:
+            # improving, non-final commit: broadcast State to all peers
+            # (updateVerifiedSignatures elif branch, :299-301)
+            st = grew & was_undone & ~reach
+            em_state = Emission(
+                mask=(st[:, None] & (self.adj >= 0)).reshape(-1),
+                from_idx=jnp.repeat(ids, n_peers),
+                to_idx=jnp.maximum(self.adj, 0).reshape(-1),
+                mtype=self.mtype("STATE"),
+                payload=jnp.repeat(
+                    self._pack(verified), n_peers, axis=0
+                ).reshape(n * n_peers, -1),
+            )
 
-        # 2. checkSigs2 beat: conditional task, min gap pairingTime
-        # (:455-479; init :310-314)
-        has_pend = jnp.any(proto["pend"], axis=1)
-        check = (
-            has_pend
-            & (state.done_at == 0)
-            & ~proto["ver_active"]
-            & (t >= 1)
-            & (t - proto["last_check"] >= p.pairing_time)
-        )
-        agg = proto["pend"]
-        useful = jnp.any(agg & ~verified, axis=1) & check
-        proto["pend"] = jnp.where(check[:, None], False, proto["pend"])
+        # 2. checkSigs beat: conditional task, min gap pairingTime
+        # (init :505-509), single verification register (see header)
+        if p.double_aggregate_strategy:
+            # checkSigs2 (:455-479): aggregate everything, verify once
+            has_pend = jnp.any(proto["pend"], axis=1)
+            check = (
+                has_pend
+                & (state.done_at == 0)
+                & ~proto["ver_active"]
+                & (t >= 1)
+                & (t - proto["last_check"] >= p.pairing_time)
+            )
+            agg = proto["pend"]
+            useful = jnp.any(agg & ~verified, axis=1) & check
+            proto["pend"] = jnp.where(check[:, None], False, proto["pend"])
+            chosen = agg
+        else:
+            # checkSigs1 (:419-447): prune zero-value entries, verify the
+            # single best
+            cand = proto["cand"]
+            v_k = jnp.sum(cand & ~verified[:, None, :], axis=2)  # [N, K]
+            occupied = jnp.any(cand, axis=2)
+            cand = cand & (v_k > 0)[:, :, None]  # iterator discard
+            check = (
+                jnp.any(occupied, axis=1)
+                & (state.done_at == 0)
+                & ~proto["ver_active"]
+                & (t >= 1)
+                & (t - proto["last_check"] >= p.pairing_time)
+            )
+            best = jnp.argmax(v_k, axis=1)
+            best_v = jnp.take_along_axis(v_k, best[:, None], axis=1)[:, 0]
+            useful = check & (best_v > 0)
+            chosen = jnp.take_along_axis(cand, best[:, None, None], axis=1)[:, 0]
+            proto["cand"] = cand.at[
+                jnp.where(useful, ids, n), best
+            ].set(False, mode="drop")
         proto["last_check"] = jnp.where(check, t, proto["last_check"])
         proto["ver_active"] = proto["ver_active"] | useful
         proto["ver_done_t"] = jnp.where(
             useful, t + 2 * p.pairing_time, proto["ver_done_t"]
         )
-        proto["ver_sig"] = jnp.where(useful[:, None], agg, proto["ver_sig"])
+        proto["ver_sig"] = jnp.where(useful[:, None], chosen, proto["ver_sig"])
 
         # 3. periodic sendSigs: push the largest diff (:336-354)
         beat = (t >= 1) & (
@@ -198,6 +289,8 @@ class BatchedP2PHandel(BatchedProtocol):
         state = state._replace(proto=proto)
         state = net.apply_emission(state, em_push)
         state = net.apply_emission(state, em_final)
+        if em_state is not None:
+            state = net.apply_emission(state, em_state)
         return state
 
     def all_done(self, state):
@@ -212,14 +305,6 @@ def make_p2phandel(
     """Host-side construction: oracle init builds the graph and the relay
     set (same JavaRandom stream)."""
     params = params or P2PHandelParameters()
-    if not params.double_aggregate_strategy:
-        raise NotImplementedError(
-            "batched P2PHandel implements the default checkSigs2 strategy"
-        )
-    if params.send_state:
-        raise NotImplementedError(
-            "batched P2PHandel does not implement State broadcasts"
-        )
     oracle = P2PHandel(params)
     oracle.init()
     net_o = oracle.network()

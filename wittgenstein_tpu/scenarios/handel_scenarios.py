@@ -82,6 +82,64 @@ SCENARIOS = {
 }
 
 
+def gen_anim(
+    nodes: int = 128,
+    sim_ms: int = 3000,
+    frequency_ms: int = 10,
+    dest: str = "handel.gif",
+) -> str:
+    """HandelScenarios.genAnim (:291) via Handel.drawImgs (:700-768): one
+    batched run rendered as a GIF — each node a map dot colored by its
+    aggregate signature count (red->green ramp), done nodes marked."""
+    from types import SimpleNamespace
+
+    import numpy as np
+
+    from ..ops.bitops import popcount_words
+    from ..protocols.handel_batched import make_handel
+    from ..tools.node_drawer import NodeDrawer, NodeStatus
+
+    net, state = make_handel(default_params(nodes, dead_ratio=0.0))
+
+    class HStatus(NodeStatus):
+        # Handel's HNodeStatus: value = signatures held, special = done
+        def get_val(self, n):
+            return n.val
+
+        def is_special(self, n):
+            return n.special
+
+        def get_max(self):
+            return nodes
+
+        def get_min(self):
+            return 0
+
+    xs = np.asarray(state.x)
+    ys = np.asarray(state.y)
+    with NodeDrawer(HStatus(), dest, frequency_ms) as drawer:
+        t = 0
+        while t < sim_ms:
+            state = net.run_ms(state, frequency_ms)
+            t += frequency_ms
+            held = np.asarray(popcount_words(state.proto["inc"]))
+            done = np.asarray(state.done_at) > 0
+            down = np.asarray(state.down)
+            live = [
+                SimpleNamespace(
+                    node_id=i,
+                    x=int(xs[i]),
+                    y=int(ys[i]),
+                    val=int(held[i]),
+                    special=bool(done[i]),
+                )
+                for i in range(nodes)
+                if not down[i]
+            ]
+            drawer.draw_new_state(t, live)
+    return dest
+
+
 def run_scenario(
     name: str,
     nodes: int = 128,
@@ -103,12 +161,17 @@ def run_scenario(
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("scenario", choices=sorted(SCENARIOS))
+    ap.add_argument("scenario", choices=sorted(SCENARIOS) + ["genAnim"])
     ap.add_argument("--nodes", type=int, default=128)
     ap.add_argument("--replicas", type=int, default=4)
     ap.add_argument("--sim-ms", type=int, default=4000)
     ap.add_argument("--out", default=None)
+    ap.add_argument("--frequency-ms", type=int, default=10)
     a = ap.parse_args(argv)
+    if a.scenario == "genAnim":
+        dest = gen_anim(a.nodes, a.sim_ms, a.frequency_ms, a.out or "handel.gif")
+        print(f"wrote {dest}")
+        return
     run_scenario(a.scenario, a.nodes, a.replicas, a.sim_ms, a.out)
 
 
