@@ -1,0 +1,77 @@
+"""Per-phase tick cost of batched Handel (the TPU_NOTES profile table).
+
+Times each tick phase in isolation by scanning it K times, after
+advancing the simulation far enough that channels/candidates carry
+realistic occupancy.  CPU numbers are a proxy for op-count cost, not
+TPU microarchitecture — use them to rank phases, not to predict chip
+throughput.
+
+Usage: [JAX_PLATFORMS=cpu] python scripts/phase_profile.py [nodes] [replicas]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, ROOT)
+import bench as benchmod  # noqa: E402
+from wittgenstein_tpu.engine import replicate_state  # noqa: E402
+from wittgenstein_tpu.protocols.handel_batched import make_handel  # noqa: E402
+
+
+def main() -> None:
+    nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    replicas = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    scans = int(os.environ.get("WITT_PROFILE_SCANS", "50"))
+
+    net, state = make_handel(benchmod._params(nodes))
+    states = replicate_state(state, replicas)
+    # realistic occupancy: run 120 simulated ms first
+    states = net.run_ms_batched(states, 120)
+    jax.block_until_ready(states)
+
+    proto = net.protocol
+
+    def scan_phase(name, fn):
+        def body(s, _):
+            return jax.vmap(fn)(s), None
+
+        stepped = jax.jit(lambda s: lax.scan(body, s, None, length=scans)[0])
+        out = stepped(states)  # compile + warm
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = stepped(states)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / scans
+        return name, dt
+
+    rows = [
+        scan_phase("full step", lambda s: net.step(s)),
+        scan_phase("channel_deliver", lambda s: proto._channel_deliver(net, s)),
+        scan_phase("commit", lambda s: proto._commit(net, s)),
+        scan_phase("dissemination", lambda s: proto._dissemination(net, s)),
+        scan_phase("select", lambda s: proto._select(net, s)),
+    ]
+    full = rows[0][1]
+    print(f"\nHandel {nodes}x{replicas}, scan x{scans}, backend={jax.default_backend()}")
+    print(f"{'phase':<18} {'ms/iter':>8} {'share':>6}")
+    for name, dt in rows:
+        print(f"{name:<18} {dt*1e3:>8.1f} {dt/full*100:>5.0f}%")
+
+
+if __name__ == "__main__":
+    main()

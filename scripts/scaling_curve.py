@@ -1,0 +1,88 @@
+"""Replica-scaling curve on the real chip (VERDICT r3 item 2).
+
+Runs `bench.py --rung NODES R` for a ladder of replica counts, each in a
+killable subprocess (a wedged TPU worker hangs forever rather than
+raising), with a cheap health probe between rungs so a crashed worker
+costs one timeout, not the whole curve.  Emits one JSON line per rung to
+stdout and writes the collected table to scaling_curve.json.
+
+Usage:  python scripts/scaling_curve.py [nodes] [R1 R2 ...]
+Defaults: 4096 nodes, R in 4 8 16 32 64.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(ROOT, "bench.py")
+RUNG_TIMEOUT_S = 1500
+PROBE_TIMEOUT_S = 150
+
+
+def _healthy() -> bool:
+    try:
+        hp = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, numpy; d = jax.devices()[0];"
+                " print(d.platform, int(numpy.asarray(jax.numpy.arange(4).sum())))",
+            ],
+            timeout=PROBE_TIMEOUT_S,
+            capture_output=True,
+            text=True,
+        )
+        last = hp.stdout.strip().splitlines()[-1] if hp.stdout.strip() else ""
+        return hp.returncode == 0 and last == "tpu 6"
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> None:
+    nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    rs = [int(x) for x in sys.argv[2:]] or [4, 8, 16, 32, 64]
+
+    rows = []
+    for r in rs:
+        t0 = time.time()
+        try:
+            p = subprocess.run(
+                [sys.executable, BENCH, "--rung", str(nodes), str(r)],
+                timeout=RUNG_TIMEOUT_S,
+                capture_output=True,
+                text=True,
+                cwd=ROOT,
+            )
+            if p.returncode == 0:
+                rec = json.loads(p.stdout.strip().splitlines()[-1])
+                rec.update(nodes=nodes, replicas=r, wall_s=round(time.time() - t0, 1))
+            else:
+                rec = {
+                    "nodes": nodes,
+                    "replicas": r,
+                    "error": f"rc={p.returncode}: {p.stderr.strip()[-300:]}",
+                }
+        except subprocess.TimeoutExpired:
+            rec = {
+                "nodes": nodes,
+                "replicas": r,
+                "error": f"rung timed out after {RUNG_TIMEOUT_S}s",
+            }
+        rows.append(rec)
+        print(json.dumps(rec), flush=True)
+        if "error" in rec and not _healthy():
+            rows.append({"error": "worker unhealthy; aborting curve"})
+            print(json.dumps(rows[-1]), flush=True)
+            break
+
+    with open(os.path.join(ROOT, "scaling_curve.json"), "w") as f:
+        json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,235 @@
+"""Patient TPU measurement campaign for the flagship bench.
+
+The tunneled chip has two hard constraints (learned in r3/r4):
+  * any single device program running past the RPC watchdog (~100 s)
+    kills the worker, and
+  * a killed/dead worker makes every jax call HANG (not raise), often
+    for hours, until the backend service restarts.
+
+Design: a SUPERVISOR process (no jax) polls health in killable
+subprocesses; when the chip is up it spawns the measuring child
+(`--run`).  The child works in SMALL steps — one chunk at a time, host
+sync between chunks, chunk length adapted to stay well under the
+watchdog — and appends every measurement to tpu_campaign.jsonl as it
+happens.  The supervisor watches that file's mtime: healthy device
+calls are <60 s and compiles <5 min, so >8 min of silence means the
+worker wedged mid-call, and the child (already hung) is safe to kill.
+Completed rungs are skipped on re-entry, so a recovered tunnel resumes
+where the wedge happened.
+
+Run detached:  nohup python scripts/tpu_campaign.py > campaign.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(ROOT, "tpu_campaign.jsonl")
+PROBE_TIMEOUT_S = 150
+POLL_INTERVAL_S = 300
+SILENCE_KILL_S = 480  # no jsonl progress for this long => child is wedged
+NODES = int(os.environ.get("WITT_CAMPAIGN_NODES", "4096"))
+REPLICA_LADDER = (4, 8, 16, 32, 64)
+SIM_MS = 1000
+SAFE_CALL_S = 60.0  # keep every device call under this (watchdog ~100 s)
+RUNG_BUDGET_S = 900  # projected full-pass cost cap per rung
+
+
+def log(rec: dict) -> None:
+    rec = dict(rec, ts=round(time.time(), 1))
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def done_rungs() -> set:
+    done = set()
+    if os.path.exists(OUT):
+        for line in open(OUT):
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if r.get("event") == "rung":
+                done.add((r["nodes"], r["replicas"]))
+    return done
+
+
+def probe_healthy() -> bool:
+    try:
+        hp = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, numpy; d = jax.devices()[0];"
+                " print(d.platform, int(numpy.asarray(jax.numpy.arange(4).sum())))",
+            ],
+            timeout=PROBE_TIMEOUT_S,
+            capture_output=True,
+            text=True,
+        )
+        last = hp.stdout.strip().splitlines()[-1] if hp.stdout.strip() else ""
+        return hp.returncode == 0 and last == "tpu 6"
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def campaign() -> None:
+    """Child mode: runs jax against the chip, one safe step at a time."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(ROOT, ".jax_cache_tpu")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+    sys.path.insert(0, ROOT)
+    import bench as benchmod
+    from wittgenstein_tpu.engine import replicate_state
+    from wittgenstein_tpu.protocols.handel_batched import make_handel
+
+    dev = jax.devices()[0]
+    log({"event": "campaign_start", "device": str(dev), "kind": dev.device_kind})
+    if dev.platform != "tpu":
+        log({"event": "abort", "reason": f"platform {dev.platform} != tpu"})
+        return
+
+    net, state0 = make_handel(benchmod._params(NODES))
+    skip = done_rungs()
+
+    results = []
+    for r in REPLICA_LADDER:
+        if (NODES, r) in skip:
+            log({"event": "rung_cached", "nodes": NODES, "replicas": r})
+            continue
+        states = replicate_state(state0, r)
+        probe_ms = 50  # first measurement chunk: small and safe
+        run = jax.jit(lambda s, c=probe_ms: net.run_ms_batched(s, c))
+
+        t0 = time.perf_counter()
+        compiled = run.lower(states).compile()
+        compile_s = time.perf_counter() - t0
+        log({"event": "compiled", "nodes": NODES, "replicas": r,
+             "chunk_ms": probe_ms, "compile_s": round(compile_s, 1)})
+
+        t0 = time.perf_counter()
+        s = compiled(states)
+        jax.block_until_ready(s)
+        first_chunk_s = time.perf_counter() - t0
+        per_tick_s = first_chunk_s / probe_ms
+        log({"event": "first_chunk", "nodes": NODES, "replicas": r,
+             "chunk_s": round(first_chunk_s, 2),
+             "per_tick_ms": round(per_tick_s * 1e3, 1)})
+
+        projected = per_tick_s * SIM_MS
+        if projected > RUNG_BUDGET_S:
+            log({"event": "rung_skipped", "replicas": r,
+                 "projected_pass_s": round(projected, 1),
+                 "reason": f"projected > {RUNG_BUDGET_S}s budget"})
+            break
+
+        # biggest SIM_MS-divisor chunk that stays under SAFE_CALL_S
+        chunk_ms = probe_ms
+        for c in (10, 20, 25, 40, 50, 100, 125, 200, 250, 500):
+            if SIM_MS % c == 0 and per_tick_s * c <= SAFE_CALL_S:
+                chunk_ms = c
+        run = jax.jit(lambda s, c=chunk_ms: net.run_ms_batched(s, c))
+        n_chunks = SIM_MS // chunk_ms
+
+        def full_pass(st):
+            for _ in range(n_chunks):
+                st = run(st)
+                jax.block_until_ready(st)
+            return st
+
+        t0 = time.perf_counter()
+        out = full_pass(states)  # includes compile at the final chunk size
+        warm_s = time.perf_counter() - t0
+        ok_done = bool(out.done_at.min() > 0)
+        t0 = time.perf_counter()
+        out = full_pass(states)
+        run_s = time.perf_counter() - t0
+        rec = {
+            "event": "rung", "nodes": NODES, "replicas": r,
+            "chunk_ms": chunk_ms, "warm_s": round(warm_s, 1),
+            "run_s": round(run_s, 2),
+            "sims_per_sec": round(r / run_s, 4),
+            "per_tick_ms": round(run_s / SIM_MS * 1e3, 2),
+            "all_done": ok_done,
+            "displaced": int(out.proto["displaced"].sum()),
+        }
+        log(rec)
+        results.append(rec)
+        # stop climbing when doubling replicas stopped paying (<1.25x)
+        if len(results) >= 2 and results[-1]["sims_per_sec"] < 1.25 * results[-2]["sims_per_sec"]:
+            log({"event": "saturated", "at_replicas": r})
+            break
+
+    if results:
+        best = max(results, key=lambda x: x["sims_per_sec"])
+        log({"event": "campaign_best", **best})
+    log({"event": "campaign_end"})
+
+
+def _mtime() -> float:
+    try:
+        return os.path.getmtime(OUT)
+    except OSError:
+        return 0.0
+
+
+def supervise() -> None:
+    deadline = time.time() + float(os.environ.get("WITT_CAMPAIGN_HOURS", "10")) * 3600
+    while time.time() < deadline:
+        if not probe_healthy():
+            log({"event": "tpu_down", "next_poll_s": POLL_INTERVAL_S})
+            time.sleep(POLL_INTERVAL_S)
+            continue
+        log({"event": "tpu_healthy"})
+        child_started = time.time()
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--run"],
+            cwd=ROOT,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        finished = False
+        while True:
+            try:
+                child.wait(timeout=30)
+                finished = True
+                break
+            except subprocess.TimeoutExpired:
+                pass
+            if time.time() - max(_mtime(), child_started) > SILENCE_KILL_S:
+                log({"event": "child_wedged",
+                     "silence_s": round(time.time() - _mtime(), 0)})
+                child.send_signal(signal.SIGKILL)
+                child.wait()
+                break
+            if time.time() > deadline:
+                log({"event": "deadline_mid_child"})
+                child.send_signal(signal.SIGKILL)
+                child.wait()
+                return
+        if finished and child.returncode == 0:
+            # campaign_end reached?  If every ladder rung is recorded or the
+            # child exited cleanly, we're done.
+            log({"event": "child_exit", "rc": child.returncode})
+            return
+        log({"event": "child_retry", "rc": child.returncode})
+        time.sleep(POLL_INTERVAL_S)
+    log({"event": "gave_up", "reason": "deadline reached with no healthy TPU"})
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--run":
+        campaign()
+    else:
+        supervise()

@@ -103,30 +103,89 @@ def test_dyn_low_matches_static(n):
                 assert to_int(got[r]) == want, (n, lv, b)
 
 
-@pytest.mark.parametrize("n", [64, 1024])
-def test_arrived_blocks_shuffles_into_receiver_space(n):
-    a = make(n)
+def test_only_two_slots_can_be_due():
+    """Delivery gathers just arrival slot (t mod D) + fresh instead of all
+    D+1 — valid because slot = arrival mod D and a slot is due exactly at
+    its arrival tick.  Run real Handel traffic and assert no OTHER slot is
+    ever due."""
+    import jax
+    from jax import lax
+    from wittgenstein_tpu.protocols.handel import HandelParameters
+    from wittgenstein_tpu.protocols.handel_batched import make_handel
+
+    n = 64
+    net, state = make_handel(
+        HandelParameters(
+            node_count=n,
+            threshold=n - 4,
+            pairing_time=3,
+            level_wait_time=20,
+            extra_cycle=5,
+            dissemination_period_ms=10,
+            fast_path=10,
+            nodes_down=0,
+        )
+    )
+    a = net.protocol
+    d = a.CHANNEL_DEPTH
+    ss = d + 1
+
+    def step_and_check(s, _):
+        in_key, due_all, _tpl = a._advance_channel(s.proto["in_key"])
+        due3 = due_all.reshape(n, a.n_levels - 1, ss)
+        sidx = lax.rem(s.time, jnp.asarray(d, jnp.int32))
+        allowed = (jnp.arange(ss) == sidx) | (jnp.arange(ss) == d)
+        stray = jnp.any(due3 & ~allowed[None, None, :])
+        return net.step(s), stray
+
+    state, strays = lax.scan(step_and_check, state, None, length=600)
+    assert not bool(jnp.any(strays))
+    assert int(np.asarray(state.done_at).min()) > 0  # traffic actually ran
+
+
+def test_send_stacked_stores_receiver_space_content():
+    """The channel holds content re-addressed into the RECEIVER's
+    block-local space at send time (bit j -> j ^ r0, r0 = (to^from) &
+    (2^(l-1)-1)); _arrived_blocks is then a pure view.  Checked via the
+    fresh-backstop slot, which every ok send overwrites."""
+    from wittgenstein_tpu.protocols.handel import HandelParameters
+    from wittgenstein_tpu.protocols.handel_batched import make_handel
+
+    n = 64
+    net, state = make_handel(
+        HandelParameters(node_count=n, threshold=n, nodes_down=0)
+    )
+    a = net.protocol
     ss = a.CHANNEL_DEPTH + 1
     rng = np.random.default_rng(5)
-    in_key, in_sigs = a._channel_init(3)
-    proto = {"in_key": in_key, **in_sigs}
-    # place known content for one (receiver, level, slot) per bucket
-    for i, b in enumerate(a.buckets):
-        arr = np.zeros((3, b.nl * ss * b.w_pad), np.uint32)
-        for j, l in enumerate(b.levels):
-            content = rng.integers(0, 2 ** min(32, a.bs[l]), dtype=np.uint64)
-            arr[0, (j * ss + 0) * b.w_pad] = np.uint32(content & 0xFFFFFFFF)
-        proto[f"in_sig{i}"] = jnp.asarray(arr)
-    for i, b in enumerate(a.buckets):
-        r0 = np.zeros((3, b.nl, ss), np.int32)
-        for j, l in enumerate(b.levels):
-            r0[0, j, 0] = (l * 7) % a.bs[l] if a.bs[l] > 1 else 0
-        got = np.asarray(a._arrived_blocks(proto, i, jnp.asarray(r0)))
-        src = np.asarray(a._sig_view(proto, i, ss))
-        for j, l in enumerate(b.levels):
-            v = to_int(src[0, j, 0])
-            want = 0
-            for bit in range(a.bs[l]):
-                if (v >> bit) & 1:
-                    want |= 1 << (bit ^ int(r0[0, j, 0]))
-            assert to_int(got[0, j, 0]) == want, (n, l)
+    recv, sender = 3, 41
+    for l in range(1, a.n_levels):
+        bs, w = a.bs[l], a.w[l]
+        bi, b = next(
+            (i, b) for i, b in enumerate(a.buckets) if b.lo <= l <= b.hi
+        )
+        content_int = int(rng.integers(1, 1 << min(60, bs)))
+        content = [
+            jnp.asarray(
+                words_of(content_int, bb.w_pad).reshape(1, bb.w_pad)
+            )
+            for bb in a.buckets
+        ]
+        out = a._send_stacked(
+            net,
+            state,
+            jnp.asarray([True]),
+            jnp.asarray([sender], jnp.int32),
+            jnp.asarray([recv], jnp.int32),
+            jnp.asarray([l], jnp.int32),
+            content,
+        )
+        got = np.asarray(a._arrived_blocks(out.proto, bi))
+        li = l - b.lo
+        fresh = to_int(got[recv, li, ss - 1, :w])
+        r0 = (recv ^ sender) & (bs - 1)
+        want = 0
+        for bit in range(bs):
+            if (content_int >> bit) & 1:
+                want |= 1 << (bit ^ r0)
+        assert fresh == want, (l, r0)

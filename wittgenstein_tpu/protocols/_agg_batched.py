@@ -11,10 +11,14 @@ oracle's per-ms message queue: per (receiver, level), D arrival-keyed
 slots (earliest arrival wins; slot = arrival mod D) plus one freshest-
 offer backstop slot that is always overwritten by the newest send — so
 when a level's traffic dies out, the last content a laggard was offered
-still delivers instead of being displaced.  Content is stored in SENDER
-bit space.  Displacements (an ok send that wins neither slot, or evicts
-a still-pending occupant) are counted in proto["displaced"] — the
-channel analog of SimState.dropped.
+still delivers instead of being displaced.  Content is stored in the
+RECEIVER's block-local bit space: the xor_shuffle re-addressing runs at
+SEND time over the send rows (sparse — dissemination fires once per
+period) instead of at delivery over every (level, slot) cell every tick,
+which measured ~9x less shuffle work and took _channel_deliver from 80%
+of the tick to a minority share.  Displacements (an ok send that wins
+neither slot, or evicts a still-pending occupant) are counted in
+proto["displaced"] — the channel analog of SimState.dropped.
 
 Program-size design (the r4 rewrite): levels are grouped into WIDTH
 BUCKETS — consecutive levels whose word width w_l = max(1, 2^(l-1)/32)
@@ -283,6 +287,34 @@ class BitsetAggBase(BatchedProtocol):
         )
         return in_key, due, empty_tpl
 
+    # -- due-slot gather ------------------------------------------------------
+    # Arrival slots are keyed slot = arrival mod D and a slot is due exactly
+    # at its arrival tick, so at tick t the ONLY slots that can be due are
+    # arrival slot (t mod D) and the fresh backstop.  Delivery therefore
+    # gathers those two columns instead of processing all D+1 — the merge
+    # runs at [K+2] instead of [K+D+1] width (pinned by
+    # tests/test_agg_buckets.py::test_only_two_slots_can_be_due).
+
+    def _due_pair_keys(self, keys3, due3, t):
+        """[N, L-1, ss] stacked keys/due -> the two due-able columns as
+        [N, L-1, 2] (index 0 = arrival slot t mod D, 1 = fresh)."""
+        sidx = lax.rem(t, jnp.int32(self.CHANNEL_DEPTH))
+        k_arr = lax.dynamic_index_in_dim(keys3, sidx, axis=2, keepdims=False)
+        d_arr = lax.dynamic_index_in_dim(due3, sidx, axis=2, keepdims=False)
+        d = self.CHANNEL_DEPTH
+        return (
+            jnp.stack([k_arr, keys3[:, :, d]], axis=2),
+            jnp.stack([d_arr, due3[:, :, d]], axis=2),
+        )
+
+    def _due_pair_sig(self, proto, i: int, t, prefix: str = "in_sig"):
+        """Bucket i's content for the two due-able slots: [N, nl, 2, w_pad]
+        in receiver block-local space."""
+        sig = self._sig_view(proto, i, self.CHANNEL_DEPTH + 1, prefix=prefix)
+        sidx = lax.rem(t, jnp.int32(self.CHANNEL_DEPTH))
+        s_arr = lax.dynamic_index_in_dim(sig, sidx, axis=2, keepdims=False)
+        return jnp.stack([s_arr, sig[:, :, self.CHANNEL_DEPTH]], axis=2)
+
     # -- the stacked send path -----------------------------------------------
     def _send_stacked(self, net, state, mask, from_idx, to_idx, level, content, aux=None):
         """Send M messages (one per row, each at its own level) into the
@@ -290,9 +322,10 @@ class BitsetAggBase(BatchedProtocol):
         wins an arrival slot, the newest offer always takes the fresh slot.
 
         mask/from_idx/to_idx/level: [M] (level in [1, L-1]); content: list
-        aligned with self.buckets of [M, w_pad] sender-space words (only
-        rows whose level lies in the bucket need valid values); aux:
-        optional [M] int32 stored per slot in proto["in_aux"].
+        aligned with self.buckets of [M, w_pad] SENDER-space words (only
+        rows whose level lies in the bucket need valid values) — they are
+        re-addressed into the receiver's block-local space here, at send
+        time; aux: optional [M] int32 stored per slot in proto["in_aux"].
         """
         proto = state.proto
         d = self.CHANNEL_DEPTH
@@ -344,13 +377,19 @@ class BitsetAggBase(BatchedProtocol):
 
         win_to = jnp.where(winner, to_idx, self.n_nodes)
         fwin_to = jnp.where(fresh_win, to_idx, self.n_nodes)
+        bs_row = jnp.asarray(self.lv_bs)[level - 1]  # [M] level block sizes
         for i, b in enumerate(self.buckets):
             in_b = (level >= b.lo) & (level <= b.hi)
             li = level - b.lo  # level row inside the bucket
             cw = jnp.arange(b.w_pad, dtype=jnp.int32)
             cols = ((li * ss + slot) * b.w_pad)[:, None] + cw
             fcols = ((li * ss + d) * b.w_pad)[:, None] + cw
-            cnt = content[i].astype(jnp.uint32)
+            # re-address sender-space content into the receiver's block-
+            # local space (bit j -> j ^ r0); r0 < bs keeps the permutation
+            # inside the level block, and rows outside the bucket are
+            # zeroed so the (dropped) shuffle can't gather out of range
+            r0 = jnp.where(in_b, rel & (bs_row - 1), 0)
+            cnt = xor_shuffle(content[i].astype(jnp.uint32), r0)
             a = updates[f"in_sig{i}"]
             a = a.at[jnp.where(in_b, win_to, self.n_nodes)[:, None], cols].set(
                 cnt, mode="drop"
@@ -372,15 +411,10 @@ class BitsetAggBase(BatchedProtocol):
             [self.msg_size(t) for t in range(self.n_levels)], np.int32
         )
 
-    # -- shared shuffle-and-merge helper -------------------------------------
-    def _arrived_blocks(self, proto, i: int, r0):
-        """Bucket i's in-flight content re-addressed into receiver
-        block-local space: [N, nl, ss, w_pad]; r0 is [N, nl, ss] (the
-        block-local xor; junk rows give junk output — mask with `due`)."""
-        ss = self.CHANNEL_DEPTH + 1
-        b = self.buckets[i]
-        sig = self._sig_view(proto, i, ss)
-        out = xor_shuffle(sig, r0)
-        # shuffle may smear content into the zero padding; re-mask
-        wm = jnp.asarray(self._width_mask(b))
-        return out * wm[None, :, None, :]
+    # -- channel content accessor --------------------------------------------
+    def _arrived_blocks(self, proto, i: int):
+        """Bucket i's in-flight content, already in receiver block-local
+        space (re-addressed at send time by _send_stacked):
+        [N, nl, ss, w_pad].  Slots that are not `due` may hold stale
+        content — consumers gate on the key/rank validity."""
+        return self._sig_view(proto, i, self.CHANNEL_DEPTH + 1)

@@ -334,8 +334,11 @@ class BatchedGSF(BitsetAggBase):
         in_key, due_all, empty_tpl = self._advance_channel(proto["in_key"])
         keys3 = self._keys_stacked(in_key)
         due3 = due_all.reshape(n, L - 1, ss)
-        rel3 = keys3 & rel_mask
+        # only arrival slot (t mod D) and the fresh slot can be due at t
+        keys2, due2 = self._due_pair_keys(keys3, due3, state.time)
+        rel2 = keys2 & rel_mask
         pk3 = proto["in_aux"].reshape(n, L - 1, ss)
+        pk2, _ = self._due_pair_keys(pk3, due3, state.time)
 
         ver, indiv = proto["ver"], proto["indiv"]
         seen, pend = proto["ind_seen"], proto["pend_ind"]
@@ -347,18 +350,18 @@ class BatchedGSF(BitsetAggBase):
             sl = slice(b.lo - 1, b.hi)
             lv = jnp.asarray(b.levels, jnp.int32)
             bs = self._bs_arr(b)
-            due = due3[:, sl, :]
-            rel = rel3[:, sl, :]
+            due = due2[:, sl, :]
+            rel = rel2[:, sl, :]
             r0 = rel & (bs[None, :, None] - 1)
-            sig_new = self._arrived_blocks(proto, i, r0)  # [N, nl, ss, w_pad]
-            pk_new = pk3[:, sl, :]
+            sig_new = self._due_pair_sig(proto, i, state.time)  # [N, nl, 2, w_pad]
+            pk_new = pk2[:, sl, :]
 
             # individual sig enqueue: once per sender per level — the bit
             # lives in the level block, so track it block-locally and
             # reassemble (no full-width onehot per slot)
             oh = jnp.where(
                 due[..., None], self._onehot(r0, b.w_pad), jnp.uint32(0)
-            )  # [N, nl, ss, w_pad]
+            )  # [N, nl, 2, w_pad]
             arrived_bits = jnp.bitwise_or.reduce(oh, axis=2)  # [N, nl, w_pad]
             seen_b = self._blocks(seen, b)
             pend_b = self._blocks(pend, b)
@@ -366,7 +369,7 @@ class BatchedGSF(BitsetAggBase):
             seen_pieces.append(seen_b | fresh)
             pend_pieces.append(pend_b | fresh)
 
-            # merge [K existing + ss new] candidates, keep top-K by score
+            # merge [K existing + 2 new] candidates, keep top-K by score
             c_key = proto["cand_key"].reshape(n, L - 1, K)[:, sl, :]
             c_pk = proto["cand_pk"].reshape(n, L - 1, K)[:, sl, :]
             c_sig = self._sig_view(proto, i, K, prefix="cand_sig")

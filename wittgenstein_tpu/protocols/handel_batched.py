@@ -23,7 +23,8 @@ message + toVerifyAgg + pairing pipeline:
      counted in proto["displaced"] and lost — Handel's periodic
      dissemination re-offers content every period, exactly the redundancy
      the reference relies on for its own dropped/filtered messages.
-     Content is stored in SENDER bit space.
+     Content is stored in the RECEIVER's block-local bit space,
+     re-addressed at send time (see BitsetAggBase._send_stacked).
   2. candidate buffer (toVerifyAgg, Handel.java:447): K slots of arrived,
      not-yet-verified aggregate sigs in receiver block-local space,
      curated exactly like bestToVerify's pruning — a candidate survives
@@ -337,46 +338,45 @@ class BatchedHandel(BitsetAggBase):
 
         in_key, due_all, empty_tpl = self._advance_channel(proto["in_key"])
 
+        keys3 = self._keys_stacked(in_key)  # [N, L-1, ss]
+        due3 = due_all.reshape(n, L - 1, ss)
+        # only arrival slot (t mod D) and the fresh slot can be due at t
+        keys2, due2 = self._due_pair_keys(keys3, due3, t)  # [N, L-1, 2]
+        rel2 = keys2 & rel_mask
+
         # (receiver traffic counters tick at send time in _send_stacked)
-        d_by_level = due_all.reshape(n, L - 1, ss)
         started = t >= proto["start_at"]
         not_done = state.done_at == 0
         filtered = jnp.sum(
-            (d_by_level & ~not_done[:, None, None]).astype(jnp.int32), axis=(1, 2)
+            (due2 & ~not_done[:, None, None]).astype(jnp.int32), axis=(1, 2)
         )
 
-        keys3 = self._keys_stacked(in_key)  # [N, L-1, ss]
-        due3 = due_all.reshape(n, L - 1, ss)
-        rel3 = keys3 & rel_mask
-
         # onNewSig drop filters: not started, done, blacklisted sender
-        bl_bit = self._getbit(proto["bl"], rel3)
-        accept = due3 & started[:, None, None] & not_done[:, None, None] & (bl_bit == 0)
+        bl_bit = self._getbit(proto["bl"], rel2)
+        accept = due2 & started[:, None, None] & not_done[:, None, None] & (bl_bit == 0)
 
         # rank + verified-sender demotion (receptionRanks += nodeCount)
-        ind_bit = self._getbit(proto["ind"], rel3)
-        rank3 = self._rank(
-            state.seed, ids[:, None, None], lv_all[None, :, None], rel3
+        ind_bit = self._getbit(proto["ind"], rel2)
+        rank2 = self._rank(
+            state.seed, ids[:, None, None], lv_all[None, :, None], rel2
         ) + self.n_nodes * ind_bit.astype(jnp.int32)
-        rank3 = jnp.where(accept, rank3, INT32_MAX)
+        rank2 = jnp.where(accept, rank2, INT32_MAX)
 
         inc, ind, bl = proto["inc"], proto["ind"], proto["bl"]
         rank_pieces, rel_pieces = [], []
         cand_sig_updates = {}
         for i, b in enumerate(self.buckets):
             sl = slice(b.lo - 1, b.hi)  # level rows of this bucket
-            bs = jnp.asarray([self.bs[l] for l in b.levels], jnp.int32)
-            r0 = rel3[:, sl, :] & (bs[None, :, None] - 1)
-            sig_new = self._arrived_blocks(proto, i, r0)  # [N, nl, ss, w_pad]
-            rank_new = rank3[:, sl, :]
-            rel_new = rel3[:, sl, :]
+            sig_new = self._due_pair_sig(proto, i, t)  # [N, nl, 2, w_pad]
+            rank_new = rank2[:, sl, :]
+            rel_new = rel2[:, sl, :]
 
-            # merge [K existing + ss new], keep top-K by (sizeIfIncluded, -rank)
+            # merge [K existing + 2 new], keep top-K by (sizeIfIncluded, -rank)
             c_rank = proto["cand_rank"].reshape(n, L - 1, K)[:, sl, :]
             c_rel = proto["cand_rel"].reshape(n, L - 1, K)[:, sl, :]
             c_sig = self._sig_view(proto, i, K, prefix="cand_sig")
 
-            all_rank = jnp.concatenate([c_rank, rank_new], axis=2)  # [N, nl, K+ss]
+            all_rank = jnp.concatenate([c_rank, rank_new], axis=2)  # [N, nl, K+2]
             all_rel = jnp.concatenate([c_rel, rel_new], axis=2)
             all_sig = jnp.concatenate([c_sig, sig_new], axis=2)
             valid = all_rank != INT32_MAX
