@@ -106,6 +106,28 @@ def _probe_backend() -> dict:
     }
 
 
+def probe_worker_healthy(timeout_s: int = PROBE_TIMEOUT_S) -> bool:
+    """One killable-subprocess TPU health probe (shared by the bench
+    ladder, scripts/scaling_curve.py and scripts/tpu_campaign.py — keep
+    the definition of 'healthy' in exactly one place)."""
+    try:
+        hp = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, numpy; d = jax.devices()[0];"
+                " print(d.platform, int(numpy.asarray(jax.numpy.arange(4).sum())))",
+            ],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        last = hp.stdout.strip().splitlines()[-1] if hp.stdout.strip() else ""
+        return hp.returncode == 0 and last == "tpu 6"
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def _params(node_ct: int):
     from wittgenstein_tpu.protocols.handel import HandelParameters
 
@@ -258,23 +280,7 @@ def main() -> None:
         # then hang for its full timeout.  One health probe (same budget as
         # the backend probe: init can take ~150 s) decides whether the rest
         # of the ladder is worth attempting.
-        try:
-            hp = subprocess.run(
-                [
-                    sys.executable,
-                    "-c",
-                    "import jax, numpy; d = jax.devices()[0];"
-                    " print(d.platform, int(numpy.asarray(jax.numpy.arange(4).sum())))",
-                ],
-                timeout=PROBE_TIMEOUT_S,
-                capture_output=True,
-                text=True,
-            )
-            last = hp.stdout.strip().splitlines()[-1] if hp.stdout.strip() else ""
-            healthy = hp.returncode == 0 and last == "tpu 6"
-        except subprocess.TimeoutExpired:
-            healthy = False
-        if not healthy:
+        if not probe_worker_healthy():
             errors.append("worker unhealthy after rung failure; skipping remaining rungs")
             break
     bench_error = "; ".join(errors) if errors else None

@@ -2,11 +2,15 @@
 
 Times each tick phase in isolation by scanning it K times, after
 advancing the simulation far enough that channels/candidates carry
-realistic occupancy.  CPU numbers are a proxy for op-count cost, not
-TPU microarchitecture — use them to rank phases, not to predict chip
-throughput.
+realistic occupancy.  Runs on the CPU backend by DEFAULT — the numbers
+are an op-count proxy for ranking phases, and a stray run must never
+touch (and possibly wedge) the tunneled chip; note the harness pins
+JAX_PLATFORMS=axon in the environment, so the env var can't express
+"user explicitly chose the device".  Set WITT_PROFILE_DEVICE=1 to
+profile on the session's device platform.  The backend actually used is
+printed in the table header.
 
-Usage: [JAX_PLATFORMS=cpu] python scripts/phase_profile.py [nodes] [replicas]
+Usage: python scripts/phase_profile.py [nodes] [replicas]
 """
 
 from __future__ import annotations
@@ -18,16 +22,18 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+_on_device = os.environ.get("WITT_PROFILE_DEVICE") == "1"
+if not _on_device:
     os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
-if os.environ.get("JAX_PLATFORMS") == "cpu":
+if not _on_device:
+    # the environment's sitecustomize pins jax_platforms=axon at the
+    # config level, overriding the env var — pin the config too
     jax.config.update("jax_platforms", "cpu")
 
-sys.path.insert(0, ROOT)
 import bench as benchmod  # noqa: E402
 from wittgenstein_tpu.engine import replicate_state  # noqa: E402
 from wittgenstein_tpu.protocols.handel_batched import make_handel  # noqa: E402

@@ -21,26 +21,10 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(ROOT, "bench.py")
 RUNG_TIMEOUT_S = 1500
-PROBE_TIMEOUT_S = 150
+PROBE_TIMEOUT_S = 150  # backend init on the tunnel can take ~150 s
 
-
-def _healthy() -> bool:
-    try:
-        hp = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax, numpy; d = jax.devices()[0];"
-                " print(d.platform, int(numpy.asarray(jax.numpy.arange(4).sum())))",
-            ],
-            timeout=PROBE_TIMEOUT_S,
-            capture_output=True,
-            text=True,
-        )
-        last = hp.stdout.strip().splitlines()[-1] if hp.stdout.strip() else ""
-        return hp.returncode == 0 and last == "tpu 6"
-    except subprocess.TimeoutExpired:
-        return False
+sys.path.insert(0, ROOT)
+from bench import probe_worker_healthy  # noqa: E402
 
 
 def main() -> None:
@@ -48,6 +32,15 @@ def main() -> None:
     rs = [int(x) for x in sys.argv[2:]] or [4, 8, 16, 32, 64]
 
     rows = []
+
+    def emit(rec):
+        rows.append(rec)
+        print(json.dumps(rec), flush=True)
+        # write after every rung: a later crash/wedge must not lose
+        # measurements already taken
+        with open(os.path.join(ROOT, "scaling_curve.json"), "w") as f:
+            json.dump(rows, f, indent=1)
+
     for r in rs:
         t0 = time.time()
         try:
@@ -59,8 +52,17 @@ def main() -> None:
                 cwd=ROOT,
             )
             if p.returncode == 0:
-                rec = json.loads(p.stdout.strip().splitlines()[-1])
-                rec.update(nodes=nodes, replicas=r, wall_s=round(time.time() - t0, 1))
+                try:
+                    rec = json.loads(p.stdout.strip().splitlines()[-1])
+                    rec.update(
+                        nodes=nodes, replicas=r, wall_s=round(time.time() - t0, 1)
+                    )
+                except (ValueError, IndexError):
+                    rec = {
+                        "nodes": nodes,
+                        "replicas": r,
+                        "error": f"unparseable rung output: {p.stdout[-200:]}",
+                    }
             else:
                 rec = {
                     "nodes": nodes,
@@ -73,15 +75,10 @@ def main() -> None:
                 "replicas": r,
                 "error": f"rung timed out after {RUNG_TIMEOUT_S}s",
             }
-        rows.append(rec)
-        print(json.dumps(rec), flush=True)
-        if "error" in rec and not _healthy():
-            rows.append({"error": "worker unhealthy; aborting curve"})
-            print(json.dumps(rows[-1]), flush=True)
+        emit(rec)
+        if "error" in rec and not probe_worker_healthy(PROBE_TIMEOUT_S):
+            emit({"error": "worker unhealthy; aborting curve"})
             break
-
-    with open(os.path.join(ROOT, "scaling_curve.json"), "w") as f:
-        json.dump(rows, f, indent=1)
 
 
 if __name__ == "__main__":

@@ -32,6 +32,9 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(ROOT, "tpu_campaign.jsonl")
 PROBE_TIMEOUT_S = 150
+
+sys.path.insert(0, ROOT)
+from bench import probe_worker_healthy  # noqa: E402
 POLL_INTERVAL_S = 300
 SILENCE_KILL_S = 480  # no jsonl progress for this long => child is wedged
 NODES = int(os.environ.get("WITT_CAMPAIGN_NODES", "4096"))
@@ -48,36 +51,21 @@ def log(rec: dict) -> None:
     print(json.dumps(rec), flush=True)
 
 
-def done_rungs() -> set:
-    done = set()
+def _events() -> list:
+    evs = []
     if os.path.exists(OUT):
         for line in open(OUT):
             try:
-                r = json.loads(line)
+                evs.append(json.loads(line))
             except ValueError:
                 continue
-            if r.get("event") == "rung":
-                done.add((r["nodes"], r["replicas"]))
-    return done
+    return evs
 
 
-def probe_healthy() -> bool:
-    try:
-        hp = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax, numpy; d = jax.devices()[0];"
-                " print(d.platform, int(numpy.asarray(jax.numpy.arange(4).sum())))",
-            ],
-            timeout=PROBE_TIMEOUT_S,
-            capture_output=True,
-            text=True,
-        )
-        last = hp.stdout.strip().splitlines()[-1] if hp.stdout.strip() else ""
-        return hp.returncode == 0 and last == "tpu 6"
-    except subprocess.TimeoutExpired:
-        return False
+def done_rungs() -> set:
+    return {
+        (r["nodes"], r["replicas"]) for r in _events() if r.get("event") == "rung"
+    }
 
 
 def campaign() -> None:
@@ -187,7 +175,7 @@ def _mtime() -> float:
 def supervise() -> None:
     deadline = time.time() + float(os.environ.get("WITT_CAMPAIGN_HOURS", "10")) * 3600
     while time.time() < deadline:
-        if not probe_healthy():
+        if not probe_worker_healthy(PROBE_TIMEOUT_S):
             log({"event": "tpu_down", "next_poll_s": POLL_INTERVAL_S})
             time.sleep(POLL_INTERVAL_S)
             continue
@@ -218,11 +206,19 @@ def supervise() -> None:
                 child.send_signal(signal.SIGKILL)
                 child.wait()
                 return
-        if finished and child.returncode == 0:
-            # campaign_end reached?  If every ladder rung is recorded or the
-            # child exited cleanly, we're done.
+        # only a campaign_end logged by THIS child counts — the jsonl is
+        # persistent across campaigns (done_rungs resume), so a stale end
+        # event from a prior run must not mask an early abort
+        reached_end = any(
+            e.get("event") == "campaign_end"
+            and e.get("ts", 0) >= child_started
+            for e in _events()
+        )
+        if finished and child.returncode == 0 and reached_end:
             log({"event": "child_exit", "rc": child.returncode})
             return
+        # rc=0 without campaign_end = the child aborted early (e.g. the
+        # tunnel flipped between probe and child start) — retry
         log({"event": "child_retry", "rc": child.returncode})
         time.sleep(POLL_INTERVAL_S)
     log({"event": "gave_up", "reason": "deadline reached with no healthy TPU"})

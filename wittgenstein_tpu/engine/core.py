@@ -295,7 +295,10 @@ class BatchedNetwork:
         return state
 
     # -- one millisecond (receiveUntil body, Network.java:586-632) -----------
-    def step(self, state: SimState) -> SimState:
+    def _step_core(self, state: SimState) -> SimState:
+        """One tick WITHOUT the time advance and WITHOUT tick_beat: ring
+        delivery + protocol.tick.  run_ms_batched's beat path guards
+        tick_beat separately with a real branch."""
         t = state.time
         due = state.msg_valid & (state.msg_arrival <= t)
         # delivery-time checks: down destination or cross-partition messages
@@ -319,7 +322,11 @@ class BatchedNetwork:
         state = state._replace(msg_valid=state.msg_valid & ~due)
         state = self.apply_emissions(state, emissions)
 
-        state = self.protocol.tick(self, state)
+        return self.protocol.tick(self, state)
+
+    def step(self, state: SimState) -> SimState:
+        state = self._step_core(state)
+        state = self.protocol.tick_beat(self, state)
         return state._replace(time=state.time + 1)
 
     def _step_jump(self, state: SimState, end) -> SimState:
@@ -355,8 +362,48 @@ class BatchedNetwork:
     @functools.partial(jax.jit, static_argnums=(0, 2))
     def run_ms_batched(self, states: SimState, ms: int) -> SimState:
         """vmapped run over the leading replica axis — the TPU replacement
-        for RunMultipleTimes' sequential reseeded loop."""
-        return jax.vmap(lambda s: self.run_ms(s, ms))(states)
+        for RunMultipleTimes' sequential reseeded loop.
+
+        When the protocol declares a sparse beat structure (BEAT_PERIOD +
+        BEAT_RESIDUES), the time loop runs OUTSIDE the vmap: replicas
+        advance time in lockstep, so the tick index is replica-uniform and
+        tick_beat can be guarded by a real lax.cond — off-beat ticks skip
+        the periodic work instead of executing it masked (a vmapped
+        lax.cond would execute both branches)."""
+        proto = self.protocol
+        period, residues = proto.BEAT_PERIOD, proto.BEAT_RESIDUES
+        if (
+            proto.TICK_INTERVAL != 1
+            or not period
+            or residues is None
+            or len(residues) >= period
+        ):
+            return jax.vmap(lambda s: self.run_ms(s, ms))(states)
+
+        step_v = jax.vmap(self._step_core)
+        beat_v = jax.vmap(lambda s: proto.tick_beat(self, s))
+        res = jnp.asarray(sorted(residues), jnp.int32)
+
+        def skip_beat(s):
+            # keep the per-event RNG stream identical to the ungated path,
+            # where the masked beat call still advanced send_ctr
+            return s._replace(send_ctr=s.send_ctr + proto.BEAT_SEND_CALLS)
+
+        def body(_, s):
+            # any-over-replicas: for the normal lockstep batch this equals
+            # replica 0's beat test; for a batch with non-uniform clocks
+            # (stacked mid-run states) tick_beat fires whenever ANY replica
+            # beats, and its per-node masks no-op the others — correct
+            # either way, and send_ctr advances by exactly 1 on every path
+            is_beat = jnp.any(
+                lax.rem(s.time.reshape(-1)[:, None], jnp.int32(period))
+                == res[None, :]
+            )
+            s = step_v(s)
+            s = lax.cond(is_beat, beat_v, skip_beat, s)
+            return s._replace(time=s.time + 1)
+
+        return lax.fori_loop(0, ms, body, states)
 
 
 def replicate_state(state: SimState, n_replicas: int, seeds=None) -> SimState:

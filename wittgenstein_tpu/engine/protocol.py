@@ -24,6 +24,22 @@ class BatchedProtocol:
     # empty milliseconds (jump to the next arrival).  Protocols with
     # periodic/conditional work must set 1 (or their smallest period).
     TICK_INTERVAL: int | None = 1
+    # Optional beat structure: periodic work (the PeriodicTask analog) that
+    # fires only when t % BEAT_PERIOD is in BEAT_RESIDUES goes in
+    # tick_beat().  Because every replica advances time in lockstep,
+    # run_ms_batched hoists the time loop outside vmap and guards
+    # tick_beat with a REAL lax.cond on the (replica-uniform) tick index —
+    # off-beat ticks skip the work entirely instead of executing it
+    # masked.  tick() must NOT include the beat work when these are set;
+    # the generic paths (run_ms, fallback run_ms_batched) call tick_beat
+    # every tick, relying on its own on-beat masks for exactness.
+    BEAT_PERIOD: int | None = None
+    BEAT_RESIDUES: tuple | None = None
+    # Number of latency_arrivals calls tick_beat makes.  On off-beat ticks
+    # the engine advances send_ctr by this amount so the per-event RNG
+    # stream is IDENTICAL to the ungated path (where the masked beat call
+    # still ticked the counter) — beat gating changes cost, never draws.
+    BEAT_SEND_CALLS: int = 0
 
     def n_msg_types(self) -> int:
         return max(1, len(self.MSG_TYPES))
@@ -54,6 +70,12 @@ class BatchedProtocol:
     def tick(self, net, state):
         """Per-millisecond hook after delivery (periodic/conditional tasks).
         Returns the full state (may emit via net.apply_emission)."""
+        return state
+
+    def tick_beat(self, net, state):
+        """Beat-gated periodic work (see BEAT_PERIOD above).  Must be a
+        no-op on off-beat ticks (its own masks), since the generic engine
+        paths call it every tick."""
         return state
 
     # -- termination ----------------------------------------------------------

@@ -84,6 +84,14 @@ class BitsetAggBase(BatchedProtocol):
     TICK_INTERVAL = 1  # verification capacity is modeled per-ms
     PAYLOAD_WIDTH = 0  # messaging bypasses the generic ring entirely
     CHANNEL_DEPTH = 8  # D: arrival-keyed in-flight slots per (receiver, level)
+    BEAT_SEND_CALLS = 1  # _dissemination makes one stacked send
+
+    def tick_beat(self, net, state):
+        """Periodic dissemination as the engine's beat hook (subclasses
+        implement _dissemination with exactly ONE stacked send, matching
+        BEAT_SEND_CALLS; it commutes with _select — no shared proto keys,
+        order-independent channel competition)."""
+        return self._dissemination(net, state)
 
     def _init_geometry(self, n: int) -> None:
         if n & (n - 1):

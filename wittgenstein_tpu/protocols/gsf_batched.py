@@ -623,7 +623,6 @@ class BatchedGSF(BitsetAggBase):
     def tick(self, net, state):
         state = self._channel_deliver(net, state)
         state = self._commit(net, state)
-        state = self._dissemination(net, state)
         state = self._select(net, state)
         return state
 
@@ -660,6 +659,9 @@ def make_gsf(
     ).astype(np.int32)
 
     proto = BatchedGSF(params)
+    # dissemination fires at t >= 1 with (t - 1) % period == 0
+    proto.BEAT_PERIOD = params.period_duration_ms
+    proto.BEAT_RESIDUES = (1 % params.period_duration_ms,)
     city_index = getattr(latency, "city_index", None)
     cols = build_node_columns(nodes, city_index)
     net = BatchedNetwork(proto, latency, n, capacity=capacity)
