@@ -19,17 +19,23 @@ oracle DES (this repo's exact-semantics port of the reference's Java event
 loop) running the identical configuration once; vs_baseline is the
 speedup: batched sims/sec divided by oracle sims/sec.
 
-Execution is CHUNKED (CHUNK_MS simulated ms per device call, host sync
-between chunks): the tunneled TPU kills any single XLA program running
-longer than its RPC watchdog (~100 s — "TPU worker process crashed"), and
-one 4096-node tick costs ~0.5 s, so a full 1000-tick run must be split.
-Found by bisection in round 3: 512x4x1000 ticks in one call survives,
-1024x4x1000 does not; 1024x4x200 does.
+Execution is CHUNKED (adaptive chunk per device call, host sync between
+chunks): the tunneled TPU kills any single XLA program running longer
+than its RPC watchdog (~100 s — "TPU worker process crashed"), so each
+rung probes one small chunk, projects the full-pass cost, sizes chunks
+to stay under ~60 s per call, and REFUSES configs that don't fit the
+budget instead of starting something the parent would have to kill
+(killing a mid-call process wedges the worker for hours — r3/r4
+lesson).  The TPU ladder climbs replicas cheap-first at 4096 nodes so a
+chip number exists within minutes; every measured rung is recorded in
+the output under "rungs" (the replica-scaling curve).
 
 Env knobs:
   WITT_BENCH_PLATFORM=cpu|tpu  skip the probe, force a platform
-  WITT_BENCH_REPLICAS=N        override the replica count
-  WITT_BENCH_CHUNK_MS=N        simulated ms per device call (default 100)
+  WITT_BENCH_REPLICAS=N        pin the replica ladder to one value
+  WITT_BENCH_BUDGET_S=N        total TPU measurement budget (default 1500)
+  WITT_BENCH_CHUNK_MS=N        upper CAP on the adaptive per-call chunk
+                               (default 500 — the largest divisor tried)
   WITT_BENCH_PROFILE=DIR       capture a jax.profiler trace of the timed run
 """
 
@@ -42,7 +48,7 @@ import sys
 import time
 
 SIM_MS = 1000
-CHUNK_MS = int(os.environ.get("WITT_BENCH_CHUNK_MS", "100"))
+CHUNK_MS = int(os.environ.get("WITT_BENCH_CHUNK_MS", "500"))
 if CHUNK_MS <= 0 or SIM_MS % CHUNK_MS != 0:
     raise SystemExit(
         f"WITT_BENCH_CHUNK_MS={CHUNK_MS} must be a positive divisor of {SIM_MS}"
@@ -155,11 +161,8 @@ def bench_oracle(node_ct: int) -> float:
     return 1.0 / dt
 
 
-def bench_batched(node_ct: int, n_replicas: int) -> dict:
+def _setup_cache() -> None:
     import jax
-
-    from wittgenstein_tpu.engine import replicate_state
-    from wittgenstein_tpu.protocols.handel_batched import make_handel
 
     # persistent compile cache: the big per-tick graphs take 30-120 s to
     # compile on the tunneled backend; cache hits skip that on re-runs.
@@ -175,10 +178,52 @@ def bench_batched(node_ct: int, n_replicas: int) -> dict:
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
+
+def bench_batched(node_ct: int, n_replicas: int, budget_s: float = 1e9) -> dict:
+    """One measured config, SELF-BUDGETING so the caller never has to kill
+    a device call mid-flight (killing wedges the tunneled worker — r3/r4
+    lesson).  Probes one small chunk first; if the projected full pass
+    exceeds budget_s, returns {"projected_s", "per_tick_ms"} instead of
+    running it, letting the parent pick a cheaper config with data in
+    hand.  Chunk length adapts to keep every device call well under the
+    ~100 s RPC watchdog."""
+    import jax
+
+    from wittgenstein_tpu.engine import replicate_state
+    from wittgenstein_tpu.protocols.handel_batched import make_handel
+
+    _setup_cache()
+
     net, state = make_handel(_params(node_ct))
     states = replicate_state(state, n_replicas)
-    n_chunks = max(1, SIM_MS // CHUNK_MS)
-    run = jax.jit(lambda s: net.run_ms_batched(s, CHUNK_MS))
+
+    probe_ms = min(CHUNK_MS, 50)
+    run_probe = jax.jit(lambda s: net.run_ms_batched(s, probe_ms))
+    t0 = time.perf_counter()
+    compiled = run_probe.lower(states).compile()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    s = compiled(states)
+    jax.block_until_ready(s)
+    per_tick_s = (time.perf_counter() - t0) / probe_ms
+
+    projected = per_tick_s * SIM_MS
+    if projected * 2 > budget_s:  # warm + timed pass must both fit
+        return {
+            "too_slow": True,
+            "per_tick_ms": round(per_tick_s * 1e3, 2),
+            "projected_s": round(projected, 1),
+            "compile_s": round(compile_s, 1),
+        }
+
+    # biggest SIM_MS-divisor chunk that stays well under the watchdog;
+    # WITT_BENCH_CHUNK_MS acts as an upper CAP (e.g. for a flaky host)
+    chunk_ms = min(probe_ms, CHUNK_MS)
+    for c in (10, 20, 25, 40, 50, 100, 125, 200, 250, 500):
+        if SIM_MS % c == 0 and c <= CHUNK_MS and per_tick_s * c <= 60.0:
+            chunk_ms = c
+    run = jax.jit(lambda s: net.run_ms_batched(s, chunk_ms))
+    n_chunks = max(1, SIM_MS // chunk_ms)
 
     def run_chunked(s):
         for _ in range(n_chunks):
@@ -187,8 +232,8 @@ def bench_batched(node_ct: int, n_replicas: int) -> dict:
         return s
 
     t0 = time.perf_counter()
-    out = run_chunked(states)  # compile + warmup
-    compile_s = time.perf_counter() - t0
+    out = run_chunked(states)  # compile at chunk_ms + warmup
+    compile_s += time.perf_counter() - t0
     assert int(out.done_at.min()) > 0, "sim did not converge"
     assert int(out.dropped.max()) == 0, "message ring overflow"
 
@@ -205,16 +250,26 @@ def bench_batched(node_ct: int, n_replicas: int) -> dict:
         "sims_per_sec": n_replicas / run_s,
         "compile_s": round(compile_s, 1),
         "run_s": round(run_s, 3),
+        "chunk_ms": chunk_ms,
     }
 
 
-def _run_rung(node_ct: int, n_replicas: int, timeout_s: int) -> dict:
-    """Run one ladder rung in a KILLABLE subprocess: a wedged TPU worker
-    makes compiles/executions hang forever (not raise), and a hang must
-    cost one rung's timeout, not the whole bench."""
+def _run_rung(node_ct: int, n_replicas: int, budget_s: float, timeout_s: int) -> dict:
+    """Run one ladder rung in a subprocess.  The child SELF-BUDGETS
+    (bench_batched probes one chunk and refuses runs that don't fit
+    budget_s), so the parent timeout only fires on a genuinely wedged
+    worker — where the device call already died and killing the hung
+    child is safe."""
     try:
         r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--rung", str(node_ct), str(n_replicas)],
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                "--rung",
+                str(node_ct),
+                str(n_replicas),
+                str(int(budget_s)),
+            ],
             timeout=timeout_s,
             capture_output=True,
             text=True,
@@ -243,52 +298,76 @@ def main() -> None:
     platform = devs[0].platform
     device_kind = getattr(devs[0], "device_kind", "?")
 
-    if platform == "tpu":
-        # 4096 first (the north-star size); the r4 width-bucket rewrite cut
-        # the per-tick program ~3x (9.8k StableHLO lines at 4096, 14 s CPU
-        # compile), so the compile that wedged the r3 worker should now fit
-        # inside the RPC watchdog — subprocess timeouts still guard it
-        ladder = [
-            (4096, 32, 1200),
-            (4096, 16, 900),
-            (4096, 8, 900),
-            (2048, 16, 700),
-            (1024, 16, 600),
-        ]
-    else:
-        ladder = [(256, 4, 900)]
-    if os.environ.get("WITT_BENCH_REPLICAS"):
-        ladder = [(ladder[0][0], int(os.environ["WITT_BENCH_REPLICAS"]), ladder[0][2])]
+    results, errors = [], []  # results: (nodes, replicas, rung dict)
+    attempted = "handel4096"  # metric label when nothing succeeds
 
-    result, errors = None, []
-    for i, (node_ct, n_replicas, rung_timeout) in enumerate(ladder):
-        if platform != "tpu":
-            try:
-                result = bench_batched(node_ct, n_replicas)
-            except Exception as e:
-                errors.append(f"{node_ct}x{n_replicas}: {type(e).__name__}: {str(e)[:300]}")
-                result = None
-            break
-        r = _run_rung(node_ct, n_replicas, rung_timeout)
-        if "error" not in r:
-            result = r
-            break
-        errors.append(r["error"])
-        if i == len(ladder) - 1:
-            break  # nothing left for a health probe to protect
-        # a big-program crash can WEDGE the worker: every later rung would
-        # then hang for its full timeout.  One health probe (same budget as
-        # the backend probe: init can take ~150 s) decides whether the rest
-        # of the ladder is worth attempting.
-        if not probe_worker_healthy():
-            errors.append("worker unhealthy after rung failure; skipping remaining rungs")
-            break
+    pinned_r = (
+        int(os.environ["WITT_BENCH_REPLICAS"])
+        if os.environ.get("WITT_BENCH_REPLICAS")
+        else None
+    )
+    if platform != "tpu":
+        cpu_r = pinned_r or 4
+        attempted = "handel256"
+        try:
+            rec = bench_batched(256, cpu_r)
+            results.append((256, cpu_r, rec))
+        except Exception as e:
+            errors.append(f"256x{cpu_r}: {type(e).__name__}: {str(e)[:300]}")
+    else:
+        # CHEAP-FIRST ladder at the north-star node count: R=4 lands a TPU
+        # number within minutes, then replicas climb while the budget
+        # lasts.  (r3/r4 lesson: the big-first ladder timed out its first
+        # rung and the kill wedged the worker — children now self-budget,
+        # so nothing healthy is ever killed mid-device-call.)
+        budget = float(os.environ.get("WITT_BENCH_BUDGET_S", "1500"))
+        t_start = time.time()
+        remaining = lambda: budget - (time.time() - t_start)
+
+        replica_ladder = (pinned_r,) if pinned_r else (4, 8, 16, 32, 64)
+        node_ct = 4096
+        for r in replica_ladder:
+            if remaining() < 60:
+                errors.append(f"budget exhausted before {node_ct}x{r}")
+                break
+            rec = _run_rung(node_ct, r, remaining(), int(remaining()) + 300)
+            if "error" in rec:
+                errors.append(rec["error"])
+                if not probe_worker_healthy():
+                    errors.append("worker unhealthy after rung failure; stopping")
+                break
+            if rec.get("too_slow"):
+                errors.append(
+                    f"{node_ct}x{r}: projected {rec['projected_s']}s exceeds "
+                    f"remaining budget (per_tick_ms={rec['per_tick_ms']})"
+                )
+                if r == replica_ladder[0]:
+                    # flagship size doesn't fit at all: fall back in nodes
+                    # so SOME chip number exists
+                    fb_r = pinned_r or 4
+                    for smaller in (2048, 1024):
+                        if remaining() < 60:
+                            break
+                        rec2 = _run_rung(smaller, fb_r, remaining(), int(remaining()) + 300)
+                        if "error" not in rec2 and not rec2.get("too_slow"):
+                            results.append((smaller, fb_r, rec2))
+                            break
+                        errors.append(f"{smaller}x{fb_r} fallback: {rec2.get('error') or 'too slow'}")
+                break
+            results.append((node_ct, r, rec))
+            if (
+                len(results) >= 2
+                and results[-1][2]["sims_per_sec"]
+                < 1.15 * results[-2][2]["sims_per_sec"]
+            ):
+                break  # replica scaling saturated
+
     bench_error = "; ".join(errors) if errors else None
-    if result is None:
+    if not results:
         print(
             json.dumps(
                 {
-                    "metric": f"handel{ladder[0][0]}_sims_per_sec_chip",
+                    "metric": f"{attempted}_sims_per_sec_chip",
                     "value": 0.0,
                     "unit": "sims/sec",
                     "vs_baseline": 0.0,
@@ -301,6 +380,7 @@ def main() -> None:
         )
         return
 
+    node_ct, n_replicas, result = max(results, key=lambda x: x[2]["sims_per_sec"])
     oracle = bench_oracle(node_ct)
     print(
         json.dumps(
@@ -315,17 +395,21 @@ def main() -> None:
                     "node_count": node_ct,
                     "n_replicas": n_replicas,
                     "sim_ms": SIM_MS,
-                    "chunk_ms": CHUNK_MS,
+                    "chunk_ms": result.get("chunk_ms", CHUNK_MS),
                 },
                 "compile_s": result["compile_s"],
                 "run_s": result["run_s"],
                 "oracle_sims_per_sec": round(oracle, 4),
+                "rungs": [
+                    dict(rec, nodes=n, replicas=r) for n, r, rec in results
+                ],
                 "workload": (
                     "handel-full: windowed scoring, Byzantine attack machinery,"
-                    " fastPath, per-node pairing.  r4 rewrote the engine onto"
-                    " stacked width-bucket bodies (same semantics, ~3x smaller"
-                    " XLA program) — comparable to r3, not to the r1/r2 lite"
-                    " engine"
+                    " fastPath, per-node pairing.  r4 second pass: send-time"
+                    " xor_shuffle, due-pair delivery, beat-gated dissemination"
+                    " (bit-identical engine semantics, ~3x faster tick than"
+                    " the r4 first pass; not comparable to the r1/r2 lite"
+                    " engine)"
                 ),
                 "probe": probe,
                 "bench_error": bench_error,
@@ -335,9 +419,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) == 4 and sys.argv[1] == "--rung":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--rung":
         # child mode: one ladder rung, JSON on stdout (no probe — the
         # parent already established the platform)
-        print(json.dumps(bench_batched(int(sys.argv[2]), int(sys.argv[3]))))
+        budget = float(sys.argv[4]) if len(sys.argv) > 4 else 1e9
+        print(json.dumps(bench_batched(int(sys.argv[2]), int(sys.argv[3]), budget)))
     else:
         main()

@@ -21,6 +21,10 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(ROOT, "bench.py")
 RUNG_TIMEOUT_S = 1500
+# child self-budget: leaves headroom under the kill timeout so a healthy
+# child always refuses (too_slow) instead of being killed mid-device-call
+# (killing wedges the tunneled worker — r3/r4 lesson)
+RUNG_BUDGET_S = RUNG_TIMEOUT_S - 400
 PROBE_TIMEOUT_S = 150  # backend init on the tunnel can take ~150 s
 
 sys.path.insert(0, ROOT)
@@ -45,7 +49,14 @@ def main() -> None:
         t0 = time.time()
         try:
             p = subprocess.run(
-                [sys.executable, BENCH, "--rung", str(nodes), str(r)],
+                [
+                    sys.executable,
+                    BENCH,
+                    "--rung",
+                    str(nodes),
+                    str(r),
+                    str(RUNG_BUDGET_S),
+                ],
                 timeout=RUNG_TIMEOUT_S,
                 capture_output=True,
                 text=True,

@@ -4,7 +4,17 @@ propagation vs the oracle (16/16 batched protocol coverage).
 The protocol's observable (time for late joiners to find their
 capabilities) depends on the join schedule itself, so the oracle
 comparison is distribution-level on aggregate propagation/completion
-stats at matched small scale (docs/enr_batched_design.md)."""
+stats at matched small scale (docs/enr_batched_design.md).
+
+Suite-cost design: ENR's event-driven step is the most expensive graph
+in the repo per iteration (~1.4k HLOs: churn + flood dedup + graph
+repair), and gossip traffic lands nearly every ms, so wall time is
+iterations x step cost.  The module therefore (a) rides the engine's
+TIME_QUANTUM=8 coarsening (arrivals delivered on an 8 ms grid — the
+schedule checks fire on window crossing, so nothing is skipped), and
+(b) runs ONE shared 30 s simulation for every read-only assertion
+instead of six separate 120 s runs.
+"""
 
 import numpy as np
 import pytest
@@ -13,7 +23,7 @@ from wittgenstein_tpu.engine import replicate_state
 from wittgenstein_tpu.protocols.enr_gossiping import ENRGossiping, ENRParameters
 from wittgenstein_tpu.protocols.enr_batched import make_enr
 
-HORIZON = 120_000
+HORIZON = 30_000
 
 
 def small_params(**kw):
@@ -24,7 +34,7 @@ def small_params(**kw):
         number_of_different_capabilities=5,
         cap_per_node=2,
         cap_gossip_time=5_000,
-        time_to_leave=200_000,  # join beat every 25_000 ms
+        time_to_leave=50_000,  # join beat every 6_250 ms
         time_to_change=10_000_000,  # no capability churn by default
         changing_nodes=1,
         discard_time=100,
@@ -33,19 +43,25 @@ def small_params(**kw):
     return ENRParameters(**base)
 
 
+@pytest.fixture(scope="module")
+def shared_run():
+    """One 30 s simulation shared by every read-only assertion."""
+    p = small_params()
+    net, state = make_enr(p, horizon_ms=HORIZON, capacity=1024)
+    out = net.run_ms(state, HORIZON)
+    return p, net, out
+
+
 class TestBatchedENR:
-    def test_converges_and_churns(self):
-        p = small_params()
-        net, state = make_enr(p, horizon_ms=HORIZON)
+    def test_converges_and_churns(self, shared_run):
+        p, net, out = shared_run
         m = net.n_nodes
         assert m > p.nodes  # join slots preallocated
-        out = net.run_ms(state, HORIZON)
         alive = np.asarray(out.proto["alive"])
-        adj = np.asarray(out.proto["adj"])
         done = np.asarray(out.done_at)
         # births happened: every joiner slot due within the horizon came
-        # alive at some point (start_time set at birth); roughly half exit
-        # again before the horizon (exit_at = born + U(0, timeToLeave)),
+        # alive at some point (start_time set at birth); some exit again
+        # before the horizon (exit_at = born + U(0, timeToLeave)),
         # exactly like the oracle
         born = np.asarray(out.proto["start_time"])[p.nodes + 1 :] > 0
         assert born.sum() >= 3, born
@@ -57,10 +73,8 @@ class TestBatchedENR:
         assert (done[alive] > 0).mean() > 0.5
         assert int(out.dropped) == 0
 
-    def test_graph_invariants(self):
-        p = small_params()
-        net, state = make_enr(p, horizon_ms=HORIZON)
-        out = net.run_ms(state, HORIZON)
+    def test_graph_invariants(self, shared_run):
+        p, net, out = shared_run
         adj = np.asarray(out.proto["adj"])
         alive = np.asarray(out.proto["alive"])
         # symmetric, no self loops, dead slots fully disconnected
@@ -70,23 +84,21 @@ class TestBatchedENR:
         # degree cap (+small slack for documented same-ms connect races)
         assert adj.sum(axis=1).max() <= p.max_peers + 3
 
-    def test_done_at_is_relative(self):
+    def test_done_at_is_relative(self, shared_run):
         """The oracle stores max(1, t - start_time) in done_at (its quirk);
         late joiners' done values must be plausible relative times."""
-        p = small_params()
-        net, state = make_enr(p, horizon_ms=HORIZON)
-        out = net.run_ms(state, HORIZON)
+        p, net, out = shared_run
         done = np.asarray(out.done_at)
         born = np.asarray(out.proto["born_at"])
         joiners = (born > 0) & (done > 0)
         if joiners.any():
             assert (done[joiners] < HORIZON).all()
 
-    def test_oracle_propagation_parity(self):
+    def test_oracle_propagation_parity(self, shared_run):
         """Aggregate parity at matched scale: completion fraction and
         distinct-source propagation within loose distribution-level
         tolerance of the oracle DES."""
-        p = small_params()
+        p, net, out = shared_run
         o = ENRGossiping(p)
         o.init()
         o.network().run_ms(HORIZON)
@@ -94,8 +106,6 @@ class TestBatchedENR:
         o_done_frac = np.mean([n.done_at > 0 for n in onodes])
         o_alive = len(onodes)
 
-        net, state = make_enr(p, horizon_ms=HORIZON)
-        out = net.run_ms(state, HORIZON)
         alive = np.asarray(out.proto["alive"])
         b_done_frac = (np.asarray(out.done_at)[alive] > 0).mean()
         b_alive = int(alive.sum())
@@ -105,24 +115,24 @@ class TestBatchedENR:
         assert abs(b_done_frac - o_done_frac) <= 0.3, (o_done_frac, b_done_frac)
 
     def test_capability_change_floods(self):
-        p = small_params(time_to_change=30_000)
-        net, state = make_enr(p, horizon_ms=60_000)
-        out = net.run_ms(state, 60_000)
+        p = small_params(time_to_change=15_000)
+        net, state = make_enr(p, horizon_ms=HORIZON, capacity=1024)
+        out = net.run_ms(state, HORIZON)
         # the changing nodes re-announced: their record seq advanced beyond
         # the pure gossip-beat count
         recs = np.asarray(out.proto["records"])
-        beats = 60_000 // p.cap_gossip_time
+        beats = HORIZON // p.cap_gossip_time
         assert recs.max() > 0
-        assert recs.max() <= beats + 60_000 // 30_000 + 2
+        assert recs.max() <= beats + HORIZON // 15_000 + 2
         assert int(out.dropped) == 0
 
     def test_replicas_and_determinism(self):
         p = small_params()
-        net, state = make_enr(p, horizon_ms=60_000)
+        net, state = make_enr(p, horizon_ms=20_000, capacity=1024)
         states = replicate_state(state, 3, seeds=[7, 8, 9])
-        a = net.run_ms_batched(states, 60_000)
+        a = net.run_ms_batched(states, 20_000)
         da = np.asarray(a.done_at)
-        b = net.run_ms_batched(states, 60_000)
+        b = net.run_ms_batched(states, 20_000)
         assert (np.asarray(b.done_at) == da).all()
         # different seeds -> different dynamics somewhere
         assert len({tuple(da[i]) for i in range(3)}) > 1

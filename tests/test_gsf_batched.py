@@ -2,6 +2,7 @@
 config), quantile-level oracle parity, budgets, batching/determinism."""
 
 import numpy as np
+import pytest
 
 from wittgenstein_tpu.engine import replicate_state
 from wittgenstein_tpu.protocols.gsf import GSFSignature, GSFSignatureParameters
@@ -87,8 +88,12 @@ class TestBatchedGSF:
         out2 = net.run_ms_batched(states, 2000)
         assert (np.asarray(out2.done_at) == done).all()
 
+    @pytest.mark.slow
     def test_north_star_2048(self):
-        """BASELINE.json config #2: GSF gossip aggregation, 2048 nodes."""
+        """BASELINE.json config #2: GSF gossip aggregation, 2048 nodes.
+        slow tier: 13 min on a single core; the default tier keeps GSF
+        parity via test_oracle_quantile_parity and the at-scale parity
+        lives in test_parity_scale.py."""
         p = make_params(node_count=2048, threshold=int(2048 * 0.99))
         net, state = make_gsf(p)
         state = net.run_ms(state, 800)

@@ -327,6 +327,7 @@ class BatchedNetwork:
     def step(self, state: SimState) -> SimState:
         state = self._step_core(state)
         state = self.protocol.tick_beat(self, state)
+        state = self.protocol.tick_post(self, state)
         return state._replace(time=state.time + 1)
 
     def _step_jump(self, state: SimState, end) -> SimState:
@@ -334,13 +335,21 @@ class BatchedNetwork:
         tick work (TICK_INTERVAL None), jump straight to the next arrival —
         the batched analog of the oracle's event loop skipping idle time
         (nextMessage's per-ms poll, Network.java:533-545, exists only
-        because conditional tasks poll empty milliseconds)."""
+        because conditional tasks poll empty milliseconds).  A protocol
+        TIME_QUANTUM > 1 additionally rounds the jump target UP to the
+        quantum grid, so a whole window of arrivals is delivered in one
+        step (each delayed < quantum ms)."""
         state = self.step(state)
         if self.protocol.TICK_INTERVAL is None:
+            q = self.protocol.TIME_QUANTUM
             next_arrival = jnp.min(
                 jnp.where(state.msg_valid, state.msg_arrival, INT_MAX)
             )
             t = jnp.clip(next_arrival, state.time, end).astype(jnp.int32)
+            if q > 1:
+                t = jnp.minimum(
+                    (t + q - 1) // q * q, jnp.asarray(end, jnp.int32)
+                ).astype(jnp.int32)
             state = state._replace(time=t)
         return state
 
@@ -382,6 +391,7 @@ class BatchedNetwork:
 
         step_v = jax.vmap(self._step_core)
         beat_v = jax.vmap(lambda s: proto.tick_beat(self, s))
+        post_v = jax.vmap(lambda s: proto.tick_post(self, s))
         res = jnp.asarray(sorted(residues), jnp.int32)
 
         def skip_beat(s):
@@ -401,6 +411,7 @@ class BatchedNetwork:
             )
             s = step_v(s)
             s = lax.cond(is_beat, beat_v, skip_beat, s)
+            s = post_v(s)
             return s._replace(time=s.time + 1)
 
         return lax.fori_loop(0, ms, body, states)

@@ -24,6 +24,13 @@ class BatchedProtocol:
     # empty milliseconds (jump to the next arrival).  Protocols with
     # periodic/conditional work must set 1 (or their smallest period).
     TICK_INTERVAL: int | None = 1
+    # Time coarsening for event-driven protocols (TICK_INTERVAL None):
+    # arrivals are delivered together at the next multiple of this grid,
+    # delaying each by < TIME_QUANTUM ms.  For protocols whose observables
+    # live at the seconds scale (ENR's record propagation), a quantum of
+    # a few ms cuts loop iterations by that factor with distortion far
+    # inside the distribution-parity tolerance.  1 = exact arrival times.
+    TIME_QUANTUM: int = 1
     # Optional beat structure: periodic work (the PeriodicTask analog) that
     # fires only when t % BEAT_PERIOD is in BEAT_RESIDUES goes in
     # tick_beat().  Because every replica advances time in lockstep,
@@ -76,6 +83,12 @@ class BatchedProtocol:
         """Beat-gated periodic work (see BEAT_PERIOD above).  Must be a
         no-op on off-beat ticks (its own masks), since the generic engine
         paths call it every tick."""
+        return state
+
+    def tick_post(self, net, state):
+        """Per-tick work that must run AFTER tick_beat (protocols whose
+        phase order interleaves dense and beat-gated phases, e.g.
+        HandelEth2's commit -> start/stop+dissemination -> select)."""
         return state
 
     # -- termination ----------------------------------------------------------

@@ -65,6 +65,11 @@ class BatchedENR(BatchedProtocol):
     MSG_TYPES = ["RECORD", "WAKE"]
     PAYLOAD_WIDTH = 2  # (source, seq)
     TICK_INTERVAL = None  # event-driven: wakes carry the schedule
+    # deliver arrivals on an 8 ms grid (each delayed < 8 ms): ENR's
+    # observables (record propagation, join/leave dynamics) live at the
+    # seconds scale, and gossip traffic lands nearly every ms, so exact
+    # arrival times buy nothing but ~8x more loop iterations
+    TIME_QUANTUM = 8
 
     def __init__(self, params: ENRParameters, m_slots: int, schedule: dict):
         self.params = params
@@ -150,6 +155,10 @@ class BatchedENR(BatchedProtocol):
             "exit_at": jnp.asarray(s["exit_at"]),
             "bcast_next": jnp.asarray(s["bcast0"]),
             "change_next": jnp.asarray(s["change0"]),
+            # time of the previous engine step: schedule checks fire on
+            # WINDOW CROSSING (last_t < sched <= t), not equality, so the
+            # TIME_QUANTUM-coarsened jump cannot step over an event
+            "last_t": jnp.int32(-1),
         }
 
     def initial_emissions(self, net, state):
@@ -180,9 +189,14 @@ class BatchedENR(BatchedProtocol):
         emissions = []
         touched = jnp.zeros(m, bool)  # nodes needing a done re-check
 
+        # schedules fire when crossed by this step's window (last_t, t] —
+        # robust to TIME_QUANTUM-coarsened jumps that skip the exact ms
+        last_t = proto["last_t"]
+        crossed = lambda sched: (sched > last_t) & (sched <= t)
+
         # ---- births (the _add_new_node beat, ENRGossiping.java:284-293;
         # the t=0 joiner is wired host-side in make_enr like the oracle's)
-        born = ~alive & (proto["born_at"] == t) & (proto["born_at"] > 0)
+        born = ~alive & crossed(proto["born_at"]) & (proto["born_at"] > 0)
         # total_peers hash-ranked alive targets per newborn
         rank = hash32(state.seed, t, ids[:, None], ids[None, :])
         eligible = alive[None, :] & (ids[None, :] != ids[:, None])
@@ -201,13 +215,13 @@ class BatchedENR(BatchedProtocol):
         touched = touched | born
 
         # ---- exits (exit_network: disconnect + stop, :198-207)
-        exiting = alive & (proto["exit_at"] == t)
+        exiting = alive & crossed(proto["exit_at"])
         keep = ~exiting
         adj = adj & keep[:, None] & keep[None, :]
         alive = alive & ~exiting
 
         # ---- capability changes (change_cap + periodic re-arm)
-        changing = alive & (proto["change_next"] == t)
+        changing = alive & crossed(proto["change_next"])
         new_caps = self._gen_caps(state.seed, ids, t)
         caps = jnp.where(changing[:, None], new_caps, caps)
         change_next = jnp.where(
@@ -216,7 +230,7 @@ class BatchedENR(BatchedProtocol):
         emissions.append(self._wake(state, ids, changing, change_next))
 
         # ---- gossip beats (broadcast_capabilities + periodic re-arm)
-        bcast = alive & (proto["bcast_next"] == t)
+        bcast = alive & crossed(proto["bcast_next"])
         announce = bcast | changing  # change_cap also floods a fresh record
         records = proto["records"]
         seq_out = records
@@ -238,6 +252,7 @@ class BatchedENR(BatchedProtocol):
                 start_time=start_time,
                 change_next=change_next,
                 bcast_next=bcast_next,
+                last_t=t,
             )
         )
         emissions.append(

@@ -189,15 +189,23 @@ class BatchedHandelEth2(BatchedProtocol):
 
     # -- per-tick ------------------------------------------------------------
     def tick(self, net, state):
-        p = self.params
-        proto = dict(state.proto)
-        t = state.time
-        n, nl, nw, k = self.n_nodes, self.nl, self.nw, self.CAND_SLOTS
-        ids = jnp.arange(n, dtype=jnp.int32)
-        live = ~state.down
-
         # ---- 1. verification commits (update at t = beat + pairing - 1) ---
+        proto = dict(state.proto)
         proto, ems_fp = self._commit(net, state, proto)
+        state = state._replace(proto=proto)
+        for em in ems_fp:
+            state = net.apply_emission(state, em)
+        return state
+
+    def tick_beat(self, net, state):
+        """Sparse periodic phases, gated by the engine's real beat branch
+        (BEAT_PERIOD; the start/stop beat at PERIOD_TIME lands on the same
+        grid because PERIOD_TIME % period_duration_ms == 0 — enforced in
+        make_handeleth2 before the attrs are set)."""
+        p = self.params
+        t = state.time
+        live = ~state.down
+        proto = dict(state.proto)
 
         # ---- 2. process start/stop beat (every PERIOD_TIME) ----------------
         beat_start = live & (t >= 1) & ((t - 1) % PERIOD_TIME == 0)
@@ -207,14 +215,19 @@ class BatchedHandelEth2(BatchedProtocol):
         beat_diss = live & (t >= 1) & ((t - 1) % p.period_duration_ms == 0)
         proto, ems = self._dissemination(state, proto, beat_diss)
 
-        # ---- 4. verify beat (every nodePairingTime) ------------------------
-        beat_ver = live & (t >= 1) & ((t - 1) % self.pairing == 0)
-        proto = self._select(state, proto, beat_ver)
-
         state = state._replace(proto=proto)
-        for em in ems_fp + ems:
+        for em in ems:
             state = net.apply_emission(state, em)
         return state
+
+    def tick_post(self, net, state):
+        # ---- 4. verify beat (every nodePairingTime, per node) --------------
+        t = state.time
+        live = ~state.down
+        proto = dict(state.proto)
+        beat_ver = live & (t >= 1) & ((t - 1) % self.pairing == 0)
+        proto = self._select(state, proto, beat_ver)
+        return state._replace(proto=proto)
 
     def _start_stop(self, state, proto, beat):
         """startNewAggregation + the expiring slot's stopAggregation
@@ -653,6 +666,14 @@ def make_handeleth2(
     city_index = getattr(latency, "city_index", None)
     cols = build_node_columns(nodes, city_index)
     proto = BatchedHandelEth2(params, roles)
+    # beat gating: tick_beat fires at t ≡ 1 (mod period_duration_ms); the
+    # PERIOD_TIME start/stop beat must land on the same grid
+    if PERIOD_TIME % params.period_duration_ms == 0:
+        proto.BEAT_PERIOD = params.period_duration_ms
+        proto.BEAT_RESIDUES = (1 % params.period_duration_ms,)
+        # send_ctr compensation: _dissemination emits P*(nl-1) ring
+        # emissions per call (one per (process, level))
+        proto.BEAT_SEND_CALLS = P * (proto.nl - 1)
     net = BatchedNetwork(proto, latency, n, capacity=capacity)
     down = np.array([nd.is_down() for nd in nodes])
     state = net.init_state(
