@@ -19,23 +19,27 @@ oracle DES (this repo's exact-semantics port of the reference's Java event
 loop) running the identical configuration once; vs_baseline is the
 speedup: batched sims/sec divided by oracle sims/sec.
 
-Execution is CHUNKED (adaptive chunk per device call, host sync between
-chunks): the tunneled TPU kills any single XLA program running longer
-than its RPC watchdog (~100 s — "TPU worker process crashed"), so each
-rung probes one small chunk, projects the full-pass cost, sizes chunks
-to stay under ~60 s per call, and REFUSES configs that don't fit the
-budget instead of starting something the parent would have to kill
-(killing a mid-call process wedges the worker for hours — r3/r4
-lesson).  The TPU ladder climbs replicas cheap-first at 4096 nodes so a
-chip number exists within minutes; every measured rung is recorded in
-the output under "rungs" (the replica-scaling curve).
+Execution is CHUNKED (one fixed CHUNK_MS program per config, AOT-compiled
+once, host sync between chunks): the tunneled TPU kills any single XLA
+program running longer than its RPC watchdog (~100 s — "TPU worker
+process crashed"), and a second chunk size would mean a second
+watchdog-killable worker-side compile.  Budget enforcement is a rolling
+check BETWEEN chunks (a partial pass returns a "too_slow" record instead
+of a result), and the ladder refuses to climb to a rung whose projected
+per-chunk time — scaled from the previous rung's measured per-tick cost —
+would approach the watchdog; nothing healthy is ever killed mid-call
+(killing a mid-call process wedges the worker for hours — r3/r4 lesson).
+The TPU ladder climbs replicas cheap-first at 4096 nodes so a chip number
+exists within minutes; every measured rung is recorded in the output
+under "rungs" (the replica-scaling curve).
 
 Env knobs:
   WITT_BENCH_PLATFORM=cpu|tpu  skip the probe, force a platform
   WITT_BENCH_REPLICAS=N        pin the replica ladder to one value
   WITT_BENCH_BUDGET_S=N        total TPU measurement budget (default 1500)
-  WITT_BENCH_CHUNK_MS=N        upper CAP on the adaptive per-call chunk
-                               (default 500 — the largest divisor tried)
+  WITT_BENCH_CHUNK_MS=N        the per-device-call chunk (default 100;
+                               one XLA program per config — no adaptive
+                               second compile)
   WITT_BENCH_PROFILE=DIR       capture a jax.profiler trace of the timed run
 """
 
@@ -48,7 +52,7 @@ import sys
 import time
 
 SIM_MS = 1000
-CHUNK_MS = int(os.environ.get("WITT_BENCH_CHUNK_MS", "500"))
+CHUNK_MS = int(os.environ.get("WITT_BENCH_CHUNK_MS", "100"))
 if CHUNK_MS <= 0 or SIM_MS % CHUNK_MS != 0:
     raise SystemExit(
         f"WITT_BENCH_CHUNK_MS={CHUNK_MS} must be a positive divisor of {SIM_MS}"
@@ -179,14 +183,44 @@ def _setup_cache() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 
+SAFE_CALL_S = 60.0  # keep every device call well under the ~100 s watchdog
+
+
+def chunked_pass(compiled, states, n_chunks, budget_s, heartbeat=None):
+    """One budgeted chunked pass over an AOT executable — THE shared
+    never-kill-mid-call loop (bench ladder + scripts/tpu_campaign.py both
+    use it; keep watchdog-safety fixes here).  Aborts BETWEEN chunks when
+    the rolling elapsed time exceeds budget_s; `heartbeat(i, chunk_s)` is
+    called after every chunk so a supervisor watching file mtime can tell
+    a long healthy pass from a wedged worker.  Returns (out, times, ok)."""
+    import jax
+
+    t_start = time.perf_counter()
+    times = []
+    st = states
+    for i in range(n_chunks):
+        t1 = time.perf_counter()
+        st = compiled(st)
+        jax.block_until_ready(st)  # keep each device program short
+        times.append(round(time.perf_counter() - t1, 2))
+        if heartbeat is not None:
+            heartbeat(i, times[-1])
+        if time.perf_counter() - t_start > budget_s and i < n_chunks - 1:
+            return st, times, False
+    return st, times, True
+
+
 def bench_batched(node_ct: int, n_replicas: int, budget_s: float = 1e9) -> dict:
     """One measured config, SELF-BUDGETING so the caller never has to kill
     a device call mid-flight (killing wedges the tunneled worker — r3/r4
-    lesson).  Probes one small chunk first; if the projected full pass
-    exceeds budget_s, returns {"projected_s", "per_tick_ms"} instead of
-    running it, letting the parent pick a cheaper config with data in
-    hand.  Chunk length adapts to keep every device call well under the
-    ~100 s RPC watchdog."""
+    lesson).  ONE XLA program per config (chunk CHUNK_MS, AOT-compiled
+    once and reused for every chunk): a second chunk size would be a
+    second watchdog-killable worker-side compile, and an early-window
+    probe underestimates per-tick cost anyway (the empty-ms jump makes
+    the first simulated ms nearly free).  The budget is enforced with
+    rolling checks BETWEEN chunks — a partial pass returns
+    {"too_slow", "per_tick_ms", "projected_s", "chunks_done"} so the
+    parent can pick a cheaper config with data in hand."""
     import jax
 
     from wittgenstein_tpu.engine import replicate_state
@@ -197,43 +231,31 @@ def bench_batched(node_ct: int, n_replicas: int, budget_s: float = 1e9) -> dict:
     net, state = make_handel(_params(node_ct))
     states = replicate_state(state, n_replicas)
 
-    probe_ms = min(CHUNK_MS, 50)
-    run_probe = jax.jit(lambda s: net.run_ms_batched(s, probe_ms))
+    chunk_ms = CHUNK_MS
+    n_chunks = max(1, SIM_MS // chunk_ms)
+    run = jax.jit(lambda s: net.run_ms_batched(s, chunk_ms))
     t0 = time.perf_counter()
-    compiled = run_probe.lower(states).compile()
+    compiled = run.lower(states).compile()
     compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    s = compiled(states)
-    jax.block_until_ready(s)
-    per_tick_s = (time.perf_counter() - t0) / probe_ms
 
-    projected = per_tick_s * SIM_MS
-    if projected * 2 > budget_s:  # warm + timed pass must both fit
+    def run_chunked(st, budget):
+        return chunked_pass(compiled, st, n_chunks, budget)
+
+    def _partial(times):
+        per_tick_s = sum(times) / (len(times) * chunk_ms)
         return {
             "too_slow": True,
             "per_tick_ms": round(per_tick_s * 1e3, 2),
-            "projected_s": round(projected, 1),
+            "projected_s": round(per_tick_s * SIM_MS, 1),
             "compile_s": round(compile_s, 1),
+            "chunks_done": len(times),
         }
 
-    # biggest SIM_MS-divisor chunk that stays well under the watchdog;
-    # WITT_BENCH_CHUNK_MS acts as an upper CAP (e.g. for a flaky host)
-    chunk_ms = min(probe_ms, CHUNK_MS)
-    for c in (10, 20, 25, 40, 50, 100, 125, 200, 250, 500):
-        if SIM_MS % c == 0 and c <= CHUNK_MS and per_tick_s * c <= 60.0:
-            chunk_ms = c
-    run = jax.jit(lambda s: net.run_ms_batched(s, chunk_ms))
-    n_chunks = max(1, SIM_MS // chunk_ms)
-
-    def run_chunked(s):
-        for _ in range(n_chunks):
-            s = run(s)
-            jax.block_until_ready(s)  # keep each device program short
-        return s
-
+    pass_budget = max(30.0, (budget_s - compile_s) / 2)  # warm + timed
     t0 = time.perf_counter()
-    out = run_chunked(states)  # compile at chunk_ms + warmup
-    compile_s += time.perf_counter() - t0
+    out, warm_times, ok = run_chunked(states, pass_budget)
+    if not ok:
+        return _partial(warm_times)
     assert int(out.done_at.min()) > 0, "sim did not converge"
     assert int(out.dropped.max()) == 0, "message ring overflow"
 
@@ -244,13 +266,18 @@ def bench_batched(node_ct: int, n_replicas: int, budget_s: float = 1e9) -> dict:
     profile_dir = os.environ.get("WITT_BENCH_PROFILE")
     with trace(profile_dir) if profile_dir else contextlib.nullcontext():
         t0 = time.perf_counter()
-        out = run_chunked(states)
+        out, chunk_times, ok = run_chunked(states, pass_budget)
         run_s = time.perf_counter() - t0
+    if not ok:
+        return _partial(chunk_times)
     return {
         "sims_per_sec": n_replicas / run_s,
         "compile_s": round(compile_s, 1),
         "run_s": round(run_s, 3),
         "chunk_ms": chunk_ms,
+        # worst single device call — the ladder projects the NEXT rung's
+        # chunk time from this before climbing (watchdog safety)
+        "max_chunk_s": max(chunk_times) if chunk_times else 0.0,
     }
 
 
@@ -326,6 +353,20 @@ def main() -> None:
 
         replica_ladder = (pinned_r,) if pinned_r else (4, 8, 16, 32, 64)
         node_ct = 4096
+
+        def _fallback_nodes():
+            # flagship size failed: fall back in nodes so SOME chip
+            # number exists
+            fb_r = pinned_r or 4
+            for smaller in (2048, 1024):
+                if remaining() < 60:
+                    return
+                rec2 = _run_rung(smaller, fb_r, remaining(), int(remaining()) + 300)
+                if "error" not in rec2 and not rec2.get("too_slow"):
+                    results.append((smaller, fb_r, rec2))
+                    return
+                errors.append(f"{smaller}x{fb_r} fallback: {rec2.get('error') or 'too slow'}")
+
         for r in replica_ladder:
             if remaining() < 60:
                 errors.append(f"budget exhausted before {node_ct}x{r}")
@@ -335,6 +376,10 @@ def main() -> None:
                 errors.append(rec["error"])
                 if not probe_worker_healthy():
                     errors.append("worker unhealthy after rung failure; stopping")
+                elif not results:
+                    # worker is fine, the flagship config isn't (transient
+                    # or config-specific): still walk down in nodes
+                    _fallback_nodes()
                 break
             if rec.get("too_slow"):
                 errors.append(
@@ -342,17 +387,7 @@ def main() -> None:
                     f"remaining budget (per_tick_ms={rec['per_tick_ms']})"
                 )
                 if r == replica_ladder[0]:
-                    # flagship size doesn't fit at all: fall back in nodes
-                    # so SOME chip number exists
-                    fb_r = pinned_r or 4
-                    for smaller in (2048, 1024):
-                        if remaining() < 60:
-                            break
-                        rec2 = _run_rung(smaller, fb_r, remaining(), int(remaining()) + 300)
-                        if "error" not in rec2 and not rec2.get("too_slow"):
-                            results.append((smaller, fb_r, rec2))
-                            break
-                        errors.append(f"{smaller}x{fb_r} fallback: {rec2.get('error') or 'too slow'}")
+                    _fallback_nodes()
                 break
             results.append((node_ct, r, rec))
             if (
@@ -361,6 +396,20 @@ def main() -> None:
                 < 1.15 * results[-2][2]["sims_per_sec"]
             ):
                 break  # replica scaling saturated
+            # watchdog guard: refuse the next rung if its projected worst
+            # chunk (linear scaling in replicas, conservative) could
+            # approach the RPC deadline — the first chunk of a too-slow
+            # rung would crash the worker before any budget check runs
+            i_next = replica_ladder.index(r) + 1
+            if i_next < len(replica_ladder):
+                proj = rec.get("max_chunk_s", 0.0) * replica_ladder[i_next] / r
+                if proj > SAFE_CALL_S:
+                    errors.append(
+                        f"stop climbing: projected chunk {proj:.0f}s at "
+                        f"{node_ct}x{replica_ladder[i_next]} exceeds the "
+                        f"{SAFE_CALL_S:.0f}s safe-call limit"
+                    )
+                    break
 
     bench_error = "; ".join(errors) if errors else None
     if not results:

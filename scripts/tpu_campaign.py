@@ -40,8 +40,9 @@ SILENCE_KILL_S = 480  # no jsonl progress for this long => child is wedged
 NODES = int(os.environ.get("WITT_CAMPAIGN_NODES", "4096"))
 REPLICA_LADDER = (4, 8, 16, 32, 64)
 SIM_MS = 1000
+CHUNK_MS = 100  # one program per rung; 100-tick chunks stayed short in r3/r4
 SAFE_CALL_S = 60.0  # keep every device call under this (watchdog ~100 s)
-RUNG_BUDGET_S = 900  # projected full-pass cost cap per rung
+RUNG_BUDGET_S = 900  # full-pass cost cap per rung (checked between chunks)
 
 
 def log(rec: dict) -> None:
@@ -97,59 +98,65 @@ def campaign() -> None:
             log({"event": "rung_cached", "nodes": NODES, "replicas": r})
             continue
         states = replicate_state(state0, r)
-        probe_ms = 50  # first measurement chunk: small and safe
-        run = jax.jit(lambda s, c=probe_ms: net.run_ms_batched(s, c))
+        # ONE chunk size for the whole rung — a second chunk size would be a
+        # second XLA program and a second worker-side compile, and a long
+        # compile is itself watchdog-killable (the r4 campaign crash).
+        n_chunks = SIM_MS // CHUNK_MS
+        run = jax.jit(lambda s: net.run_ms_batched(s, CHUNK_MS))
 
         t0 = time.perf_counter()
         compiled = run.lower(states).compile()
         compile_s = time.perf_counter() - t0
         log({"event": "compiled", "nodes": NODES, "replicas": r,
-             "chunk_ms": probe_ms, "compile_s": round(compile_s, 1)})
+             "chunk_ms": CHUNK_MS, "compile_s": round(compile_s, 1)})
+
+        def heartbeat(i, chunk_s, r=r):
+            # every-5th-chunk jsonl write keeps worst-case mtime silence at
+            # ~5*SAFE_CALL_S < SILENCE_KILL_S, so the supervisor can tell a
+            # long healthy pass from a wedged worker and never kills one
+            if chunk_s > SAFE_CALL_S:
+                log({"event": "chunk_over_safe", "replicas": r,
+                     "chunk": i, "chunk_s": chunk_s})
+            elif i % 5 == 0:
+                log({"event": "hb", "replicas": r, "chunk": i,
+                     "chunk_s": chunk_s})
+
+        def full_pass(st, budget_s):
+            """The shared never-kill-mid-call loop (bench.chunked_pass);
+            early chunks are cheap — empty-ms jumps — so per-chunk times
+            are logged, not assumed."""
+            return benchmod.chunked_pass(
+                compiled, st, n_chunks, budget_s, heartbeat=heartbeat
+            )
 
         t0 = time.perf_counter()
-        s = compiled(states)
-        jax.block_until_ready(s)
-        first_chunk_s = time.perf_counter() - t0
-        per_tick_s = first_chunk_s / probe_ms
-        log({"event": "first_chunk", "nodes": NODES, "replicas": r,
-             "chunk_s": round(first_chunk_s, 2),
-             "per_tick_ms": round(per_tick_s * 1e3, 1)})
-
-        projected = per_tick_s * SIM_MS
-        if projected > RUNG_BUDGET_S:
-            log({"event": "rung_skipped", "replicas": r,
-                 "projected_pass_s": round(projected, 1),
-                 "reason": f"projected > {RUNG_BUDGET_S}s budget"})
-            break
-
-        # biggest SIM_MS-divisor chunk that stays under SAFE_CALL_S
-        chunk_ms = probe_ms
-        for c in (10, 20, 25, 40, 50, 100, 125, 200, 250, 500):
-            if SIM_MS % c == 0 and per_tick_s * c <= SAFE_CALL_S:
-                chunk_ms = c
-        run = jax.jit(lambda s, c=chunk_ms: net.run_ms_batched(s, c))
-        n_chunks = SIM_MS // chunk_ms
-
-        def full_pass(st):
-            for _ in range(n_chunks):
-                st = run(st)
-                jax.block_until_ready(st)
-            return st
-
-        t0 = time.perf_counter()
-        out = full_pass(states)  # includes compile at the final chunk size
+        out, warm_times, ok = full_pass(states, RUNG_BUDGET_S)
         warm_s = time.perf_counter() - t0
+        if not ok:
+            log({"event": "rung_aborted", "nodes": NODES, "replicas": r,
+                 "chunk_times": warm_times,
+                 "reason": f"pass exceeded {RUNG_BUDGET_S}s budget"})
+            break
         ok_done = bool(out.done_at.min() > 0)
         t0 = time.perf_counter()
-        out = full_pass(states)
+        out, chunk_times, ok = full_pass(states, RUNG_BUDGET_S)
         run_s = time.perf_counter() - t0
+        if not ok:
+            # a partial timed pass must NOT be logged as a completed rung:
+            # done_rungs() would skip it forever and sims_per_sec would be
+            # inflated by the missing chunks
+            log({"event": "rung_aborted", "nodes": NODES, "replicas": r,
+                 "chunk_times": chunk_times,
+                 "reason": "timed pass exceeded budget (worker degraded?)"})
+            break
         rec = {
             "event": "rung", "nodes": NODES, "replicas": r,
-            "chunk_ms": chunk_ms, "warm_s": round(warm_s, 1),
+            "chunk_ms": CHUNK_MS, "warm_s": round(warm_s, 1),
             "run_s": round(run_s, 2),
             "sims_per_sec": round(r / run_s, 4),
             "per_tick_ms": round(run_s / SIM_MS * 1e3, 2),
             "all_done": ok_done,
+            "chunk_times": chunk_times,
             "displaced": int(out.proto["displaced"].sum()),
         }
         log(rec)
@@ -158,6 +165,18 @@ def campaign() -> None:
         if len(results) >= 2 and results[-1]["sims_per_sec"] < 1.25 * results[-2]["sims_per_sec"]:
             log({"event": "saturated", "at_replicas": r})
             break
+        # watchdog guard: refuse a rung whose projected worst chunk
+        # (linear replica scaling, conservative) could approach the RPC
+        # deadline — its FIRST chunk would crash the worker before any
+        # budget check runs
+        i_next = REPLICA_LADDER.index(r) + 1
+        if i_next < len(REPLICA_LADDER):
+            proj = max(chunk_times) * REPLICA_LADDER[i_next] / r
+            if proj > SAFE_CALL_S:
+                log({"event": "stop_climbing",
+                     "next_replicas": REPLICA_LADDER[i_next],
+                     "projected_chunk_s": round(proj, 1)})
+                break
 
     if results:
         best = max(results, key=lambda x: x["sims_per_sec"])
@@ -174,6 +193,7 @@ def _mtime() -> float:
 
 def supervise() -> None:
     deadline = time.time() + float(os.environ.get("WITT_CAMPAIGN_HOURS", "10")) * 3600
+    child_err = open(os.path.join(ROOT, "campaign_child.log"), "ab")
     while time.time() < deadline:
         if not probe_worker_healthy(PROBE_TIMEOUT_S):
             log({"event": "tpu_down", "next_poll_s": POLL_INTERVAL_S})
@@ -185,7 +205,7 @@ def supervise() -> None:
             [sys.executable, os.path.abspath(__file__), "--run"],
             cwd=ROOT,
             stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
+            stderr=child_err,
         )
         finished = False
         while True:

@@ -30,11 +30,25 @@ a preallocated block table — the SURVEY §7 step-7 design:
     ETHMiner.java:133-141) — same next-beat timing as the oracle's
     in_mining=None + next mine10ms.
 
+Byzantine miners (byz_class_name, miner at pos 1 like ETHPoW.java:78-87):
+ETHSelfishMiner and ETHSelfishMiner2 (Eyal-Sirer algorithm 1 and the
+total-difficulty variant, ETHSelfishMiner.java / ETHSelfishMiner2.java via
+the oracle port) run on the batched path — withheld blocks are table rows
+whose arrival is INT32_MAX for everyone but the producer, the private
+chain is a bool[B] `withheld` mask, and the release walks (competing-block
+search + suffix broadcast) are scalar `lax.while_loop`s over the parent
+array.  The reference's send_all_mined quirk — the hook drops withheld
+blocks instead of broadcasting them (ETHMiner.java:165-171) — is kept
+verbatim.  Same-beat simultaneity approximation: of several external
+blocks arriving in one 10 ms beat only the best (max total difficulty) is
+processed as `on_received_block`; the others can't have beaten it for
+other_miners_head anyway.  Agent/CSV miners (stepwise RL bridge) stay on
+the oracle.
+
 Deliberate simplifications (the spike's documented scope — see
 docs/batched_blockchain_design.md for the fork-choice design note and the
 Casper/Dfinity plan):
 
-  * honest miners only (selfish/agent strategies stay on the oracle);
   * no uncles: possibleUncles is a bounded DAG walk the batched table
     can do, but the spike keeps y=1 in the difficulty formula and skips
     uncle rewards — block-interval dynamics are uncle-independent at the
@@ -71,6 +85,10 @@ GENESIS_DIFFICULTY = 1_949_482_043_446_410.0
 GENESIS_HEIGHT = 7_951_081  # mainnet block (ETHPoW.java:158-164)
 TOTAL_HASH_POWER_GHS = 200 * 1024  # ETHPoW.java:72
 BEAT_MS = 10
+SELFISH_ID = 1  # the bad node is always at pos 1 (ETHPoW.java:78-87)
+
+# byz_class_name -> batched strategy id; agent miners stay oracle-only
+BATCHED_BYZ = {"ETHMiner": 0, "ETHSelfishMiner": 1, "ETHSelfishMiner2": 2}
 
 
 @jax.tree_util.register_pytree_node_class
@@ -97,6 +115,10 @@ class EthPowState:
     cand_diff: jnp.ndarray  # float32[M]
     mining: jnp.ndarray  # bool[M]
     blocks_mined: jnp.ndarray  # int32[M]
+    # selfish-miner columns (inert when no byz strategy is configured)
+    pmb: jnp.ndarray  # int32 scalar: private_miner_block idx, -1 = None
+    omh: jnp.ndarray  # int32 scalar: other_miners_head idx
+    withheld: jnp.ndarray  # bool[B]: mined_to_send set
 
     def tree_flatten(self):
         return (
@@ -121,10 +143,17 @@ class BatchedEthPow:
     ):
         params = params or ETHPoWParameters()
         if params.byz_class_name:
-            raise NotImplementedError(
-                "batched ETHPoW is the honest-miner spike; Byzantine miner "
-                "strategies run on the oracle (protocols/ethpow.py)"
-            )
+            key = params.byz_class_name.rsplit(".", 1)[-1]
+            if key not in BATCHED_BYZ:
+                raise NotImplementedError(
+                    f"batched ETHPoW supports {sorted(BATCHED_BYZ)} as "
+                    "byz_class_name; agent/CSV miners (stepwise RL bridge) "
+                    "run on the oracle (protocols/ethpow.py)"
+                )
+            self.variant = BATCHED_BYZ[key]
+        else:
+            self.variant = None
+        self.selfish = self.variant in (1, 2)
         self.params = params
         self.b_max = b_max
         self.m = params.number_of_miners
@@ -137,10 +166,17 @@ class BatchedEthPow:
         city_index = getattr(self.latency, "city_index", None)
         self.cols = build_node_columns(nodes, city_index)
         self.static = LatencyStatic.from_columns(self.cols)
-        # even split of the network hash power (ETHPoW.java:70-87, honest)
-        hp = TOTAL_HASH_POWER_GHS // self.m
+        # hash-power split (ETHPoW.java:70-87): miner 1 takes the byz
+        # share, honest miners split the remainder evenly
+        total = TOTAL_HASH_POWER_GHS
+        byz_hp = int(total * params.byz_mining_ratio) if self.variant is not None else 0
+        honest_n = self.m if byz_hp == 0 else self.m - 1
+        honest_hp = (total - byz_hp) // honest_n
+        hp = np.full(self.m, honest_hp, np.float64)
+        if self.variant is not None:
+            hp[SELFISH_ID] = byz_hp
         # P(success per 10 ms) = 1 - exp(-hashes_per_10ms / difficulty)
-        self.hp_per_10ms = float(hp) * (1024.0**3) / 100.0
+        self.hp_per_10ms = jnp.asarray(hp * (1024.0**3) / 100.0, jnp.float32)
 
     # -- state ---------------------------------------------------------------
     def init_state(self, seed: int = 0) -> EthPowState:
@@ -166,6 +202,9 @@ class BatchedEthPow:
             cand_diff=jnp.full(m, GENESIS_DIFFICULTY, jnp.float32),
             mining=jnp.zeros(m, bool),
             blocks_mined=zi(m),
+            pmb=jnp.int32(-1),
+            omh=jnp.int32(0),  # genesis (ETHSelfishMiner.java ctor)
+            withheld=jnp.zeros(b, bool),
         )
 
     # -- difficulty (ETHPoW.java:284-296; low-height bomb quirk kept) --------
@@ -180,6 +219,93 @@ class BatchedEthPow:
             diff,  # the reference's own low-height behavior
         )
         return f_diff + diff + bomb
+
+    # -- selfish receive phase (once per beat, scalar per replica) -----------
+    def _selfish_receive(self, s: EthPowState, t, new_head):
+        """on_received_block for the miner at pos 1, applied to the best
+        newly-arrived external block of this beat.
+
+        Variant 1 = ETHSelfishMiner.java:56-115 (height-delta algorithm),
+        variant 2 = ETHSelfishMiner2.java:55-81 (total-difficulty walk).
+        Returns (omh, withheld, arrival, force_restart)."""
+        sm = SELFISH_ID
+        m = self.m
+        mids = jnp.arange(m, dtype=jnp.int32)
+        prod, par, hgt, td = s.producer, s.parent, s.height, s.td
+        arr_sm = s.arrival[:, sm]
+
+        newly = (arr_sm > t - BEAT_MS) & (arr_sm <= t) & (prod != sm) & (prod >= 0)
+        rcv = jnp.argmax(jnp.where(newly, td, -1.0)).astype(jnp.int32)
+        # omh = best(omh, rcv): rcv is never ours, so a tie keeps omh
+        # (ETHPoW.best :337-348); "if omh is not rcv: return"
+        act = jnp.any(newly) & (td[rcv] > td[s.omh])
+        omh = jnp.where(act, rcv, s.omh)
+
+        ph = jnp.where(s.pmb >= 0, hgt[s.pmb], 0)
+        safe_pmb = jnp.maximum(s.pmb, 0)
+
+        if self.variant == 1:
+            delta_p = ph - (hgt[rcv] - 1)
+            lose = act & (delta_p <= 0)  # "they won: we move to their chain"
+            rel = act & (delta_p > 0)
+            far = rel & (delta_p > 2)
+            # far ahead: walk down to the oldest withheld block still above
+            # rcv's height (ETHSelfishMiner.java:96-103)
+            ts = lax.while_loop(
+                lambda i: far & s.withheld[par[i]] & (hgt[i] > hgt[rcv]),
+                lambda i: par[i],
+                safe_pmb,
+            )
+            # if we couldn't reach rcv's height, check the ancestor at that
+            # height still beats rcv — otherwise sending can't win: return
+            need = far & (hgt[ts] != hgt[rcv])
+            f = lax.while_loop(
+                lambda i: need & (hgt[i] != hgt[rcv]) & (i != 0),
+                lambda i: par[i],
+                ts,
+            )
+            cancel = need & (td[f] < td[rcv])
+            do_rel = rel & ~cancel
+        else:  # variant 2
+            lose = act & (new_head[SELFISH_ID] == rcv)  # "if self.head is rcv"
+            rel = act & ~lose & (s.pmb >= 0)
+            # walk toward the oldest own block whose parent still beats rcv
+            # on total difficulty (ETHSelfishMiner2.java:66-71)
+            ts = lax.while_loop(
+                lambda i: rel & (i != 0) & (hgt[i] >= hgt[rcv]) & (td[par[i]] > td[rcv]),
+                lambda i: par[i],
+                safe_pmb,
+            )
+            do_rel = rel
+
+        # losing clears mined_to_send via send_all_mined, whose hook DROPS
+        # the blocks for selfish miners (ETHMiner.java:165-171 quirk), then
+        # restarts mining on the head
+        withheld = jnp.where(lose, jnp.zeros_like(s.withheld), s.withheld)
+
+        # release loop: send to_send and its withheld own ancestors
+        # (ETHSelfishMiner.java:105-114); each send_block samples per-dest
+        # latency for its own event, arrival at t+1+latency (send_block
+        # :315-322 -> send_all)
+        sm_vec = jnp.full(m, sm, jnp.int32)
+
+        def rl_cond(c):
+            omh_, wh_, ar_, i = c
+            return do_rel & (i > 0) & (prod[i] == sm) & wh_[i]
+
+        def rl_body(c):
+            omh_, wh_, ar_, i = c
+            omh_ = jnp.where(td[i] >= td[omh_], i, omh_)  # best: own wins ties
+            ev = hash32(s.seed, t, i, jnp.int32(0x5E1F))
+            dlt = pseudo_delta(mids, ev)
+            lat = vec_latency(self.latency, self.static, sm_vec, mids, dlt)
+            row = jnp.where(mids == sm, ar_[i, sm], t + 1 + lat)
+            return (omh_, wh_.at[i].set(False), ar_.at[i].set(row), par[i])
+
+        omh, withheld, arrival, _ = lax.while_loop(
+            rl_cond, rl_body, (omh, withheld, s.arrival, ts)
+        )
+        return omh, withheld, arrival, lose
 
     # -- one 10 ms beat ------------------------------------------------------
     def _beat(self, s: EthPowState) -> EthPowState:
@@ -201,9 +327,20 @@ class BatchedEthPow:
         first_own = jnp.argmax(own_max, axis=0).astype(jnp.int32)
         new_head = jnp.where(has_own, first_own, first_any)
 
+        # 1b. selfish receive phase (arrival events land before this beat's
+        # mining trial; a forced restart = start_new_mining(head) after
+        # losing the race)
+        if self.selfish:
+            omh, withheld, arrival_in, lose = self._selfish_receive(s, t, new_head)
+        else:
+            omh, withheld, arrival_in = s.omh, s.withheld, s.arrival
+            lose = None
+
         # 2. head change (or no candidate yet) restarts mining on the head
         # with a fresh candidate stamped now (startNewMining)
         restart = (new_head != s.head) | ~s.mining
+        if lose is not None:
+            restart = restart | (lose & (mids == SELFISH_ID))
         father = jnp.where(restart, new_head, s.father)
         cand_time = jnp.where(restart, t, s.cand_time)
         cand_diff = jnp.where(
@@ -243,10 +380,35 @@ class BatchedEthPow:
         lat = vec_latency(self.latency, static, from_idx, to_idx, delta)
         arr = (t + 1 + lat).reshape(m, m)
         arr = jnp.where(jnp.eye(m, dtype=bool), t, arr)  # own block now
-        arrival = s.arrival.at[slot].set(arr, mode="drop")
+        if self.selfish:
+            # the selfish miner withholds: its block reaches only itself
+            # (send_mined_block returns False, ETHSelfishMiner.java:46-48)
+            sm_row = jnp.where(mids == SELFISH_ID, t, INT32_MAX)
+            arr = arr.at[SELFISH_ID].set(sm_row)
+        arrival = arrival_in.at[slot].set(arr, mode="drop")
 
         n_ok = jnp.sum(fits.astype(jnp.int32))
         lost = jnp.sum((success & ~fits).astype(jnp.int32))
+
+        # 4b. selfish on_mined_block (ETHSelfishMiner[2].java:38-54, same in
+        # both variants): track the private block; at delta_p == 0 with a
+        # 2-deep own chain, adopt it as other_miners_head and clear the
+        # withheld set (send_all_mined's hook-drop quirk)
+        pmb = s.pmb
+        if self.selfish:
+            sm = SELFISH_ID
+            k = idx[sm]
+            mined_ok = success[sm] & fits[sm]
+            f_sm = father[sm]
+            hk = s.height[f_sm] + 1
+            td_k = new_td[sm]
+            withheld = withheld.at[jnp.where(mined_ok, k, b)].set(True, mode="drop")
+            delta_pm = hk - (s.height[omh] - 1)
+            depth2 = (s.producer[f_sm] == sm) & (s.producer[s.parent[f_sm]] != sm)
+            publish0 = mined_ok & (delta_pm == 0) & depth2
+            omh = jnp.where(publish0 & (td_k >= s.td[omh]), k, omh)
+            withheld = jnp.where(publish0, jnp.zeros_like(withheld), withheld)
+            pmb = jnp.where(mined_ok, k, s.pmb)
 
         return EthPowState(
             time=t + BEAT_MS,
@@ -268,6 +430,9 @@ class BatchedEthPow:
             # its own block next beat, exactly like the oracle
             mining=~success,
             blocks_mined=s.blocks_mined + success.astype(jnp.int32),
+            pmb=pmb,
+            omh=omh,
+            withheld=withheld,
         )
 
     # -- run -----------------------------------------------------------------
@@ -293,6 +458,35 @@ def replicate_ethpow(state: EthPowState, n_replicas: int, seeds=None) -> EthPowS
         lambda a: jnp.broadcast_to(a, (n_replicas,) + a.shape), state
     )
     return dataclasses.replace(tiled, seed=seeds)
+
+
+def chain_producers(state: EthPowState, replica: Optional[int] = None) -> np.ndarray:
+    """Host-side: producer ids along the PUBLIC winning chain, tip to
+    genesis (exclusive).  The tip is the best block the observer (miner 0,
+    honest) has actually received — the oracle comparator walks
+    observer.head, so counting the selfish miner's still-withheld private
+    blocks would systematically overstate its revenue.  The batched analog
+    of try_miner's revenue ratio without uncle rewards
+    (ETHMiner.java:234-308)."""
+    if replica is not None:
+        state = jax.tree_util.tree_map(lambda a: a[replica], state)
+    td = np.asarray(state.td)
+    n = int(state.n_blocks)
+    parent = np.asarray(state.parent)
+    producer = np.asarray(state.producer)
+    known = np.asarray(state.arrival)[:n, 0] <= int(state.time)
+    cur = int(np.argmax(np.where(known, td[:n], -1.0)))
+    out = []
+    while cur != 0:
+        out.append(int(producer[cur]))
+        cur = int(parent[cur])
+    return np.asarray(out, np.int32)
+
+
+def selfish_revenue_ratio(state: EthPowState, replica: Optional[int] = None) -> float:
+    """Share of winning-chain blocks produced by the miner at pos 1."""
+    pr = chain_producers(state, replica)
+    return float((pr == SELFISH_ID).mean()) if len(pr) else 0.0
 
 
 def chain_intervals(state: EthPowState, replica: Optional[int] = None) -> np.ndarray:
