@@ -198,10 +198,23 @@ def chunked_pass(compiled, states, n_chunks, budget_s, heartbeat=None):
     t_start = time.perf_counter()
     times = []
     st = states
+    # sync leaf: a single program's outputs materialize together, so a
+    # device->host readback of the SMALLEST output is ground-truth
+    # completion for the whole chunk.  block_until_ready alone is not
+    # enough over the tunneled backend: it acks while the program is
+    # still queued (observed r4: 0.01 s "chunks" followed by an
+    # unbounded silent wait), which both falsifies the timings and lets
+    # the client stack many programs onto a worker it believes is idle.
+    import numpy as np
+
+    def _sync(s):
+        leaves = jax.tree_util.tree_leaves(s)
+        np.asarray(min(leaves, key=lambda a: getattr(a, "size", 1 << 62)))
+
     for i in range(n_chunks):
         t1 = time.perf_counter()
         st = compiled(st)
-        jax.block_until_ready(st)  # keep each device program short
+        _sync(st)  # keep exactly one short program in flight
         times.append(round(time.perf_counter() - t1, 2))
         if heartbeat is not None:
             heartbeat(i, times[-1])

@@ -34,14 +34,15 @@ OUT = os.path.join(ROOT, "tpu_campaign.jsonl")
 PROBE_TIMEOUT_S = 150
 
 sys.path.insert(0, ROOT)
-from bench import probe_worker_healthy  # noqa: E402
+from bench import SAFE_CALL_S, probe_worker_healthy  # noqa: E402
 POLL_INTERVAL_S = 300
-SILENCE_KILL_S = 480  # no jsonl progress for this long => child is wedged
+SILENCE_KILL_S = 900  # no jsonl progress for this long => child is wedged
+COMPILE_LIMIT_S = 780  # child self-aborts a compile running past this
+CHUNK_LIMIT_S = 180  # ... and a device chunk past this (watchdog is ~100 s)
 NODES = int(os.environ.get("WITT_CAMPAIGN_NODES", "4096"))
 REPLICA_LADDER = (4, 8, 16, 32, 64)
 SIM_MS = 1000
 CHUNK_MS = 100  # one program per rung; 100-tick chunks stayed short in r3/r4
-SAFE_CALL_S = 60.0  # keep every device call under this (watchdog ~100 s)
 RUNG_BUDGET_S = 900  # full-pass cost cap per rung (checked between chunks)
 
 
@@ -69,9 +70,26 @@ def done_rungs() -> set:
     }
 
 
+_phase_deadline = [None]  # child phase watchdog (compile / chunk limits)
+
+
+def _phase_watchdog() -> None:
+    while True:
+        time.sleep(10)
+        d = _phase_deadline[0]
+        if d is not None and time.time() > d:
+            log({"event": "phase_overrun_abort",
+                 "over_s": round(time.time() - d, 1)})
+            os._exit(3)
+
+
 def campaign() -> None:
     """Child mode: runs jax against the chip, one safe step at a time."""
+    import threading
+
     import jax
+
+    threading.Thread(target=_phase_watchdog, daemon=True).start()
 
     jax.config.update(
         "jax_compilation_cache_dir", os.path.join(ROOT, ".jax_cache_tpu")
@@ -104,30 +122,39 @@ def campaign() -> None:
         n_chunks = SIM_MS // CHUNK_MS
         run = jax.jit(lambda s: net.run_ms_batched(s, CHUNK_MS))
 
+        # the compile is one long blocking call: log its START so the
+        # supervisor's mtime watchdog doesn't count tracing+compile as
+        # silence (it SIGKILLed two healthy children mid-compile in r4),
+        # and self-abort via the phase watchdog if it truly runs away
+        log({"event": "compiling", "nodes": NODES, "replicas": r,
+             "limit_s": COMPILE_LIMIT_S})
+        _phase_deadline[0] = time.time() + COMPILE_LIMIT_S
         t0 = time.perf_counter()
         compiled = run.lower(states).compile()
         compile_s = time.perf_counter() - t0
+        _phase_deadline[0] = None
         log({"event": "compiled", "nodes": NODES, "replicas": r,
              "chunk_ms": CHUNK_MS, "compile_s": round(compile_s, 1)})
 
         def heartbeat(i, chunk_s, r=r):
-            # every-5th-chunk jsonl write keeps worst-case mtime silence at
-            # ~5*SAFE_CALL_S < SILENCE_KILL_S, so the supervisor can tell a
-            # long healthy pass from a wedged worker and never kills one
-            if chunk_s > SAFE_CALL_S:
-                log({"event": "chunk_over_safe", "replicas": r,
-                     "chunk": i, "chunk_s": chunk_s})
-            elif i % 5 == 0:
-                log({"event": "hb", "replicas": r, "chunk": i,
-                     "chunk_s": chunk_s})
+            # every chunk: with the readback sync in chunked_pass the
+            # times are honest, and per-chunk writes give the supervisor
+            # the tightest possible wedge detection
+            ev = "chunk_over_safe" if chunk_s > SAFE_CALL_S else "hb"
+            log({"event": ev, "replicas": r, "chunk": i, "chunk_s": chunk_s})
+            _phase_deadline[0] = time.time() + CHUNK_LIMIT_S
 
         def full_pass(st, budget_s):
             """The shared never-kill-mid-call loop (bench.chunked_pass);
             early chunks are cheap — empty-ms jumps — so per-chunk times
             are logged, not assumed."""
-            return benchmod.chunked_pass(
-                compiled, st, n_chunks, budget_s, heartbeat=heartbeat
-            )
+            _phase_deadline[0] = time.time() + CHUNK_LIMIT_S
+            try:
+                return benchmod.chunked_pass(
+                    compiled, st, n_chunks, budget_s, heartbeat=heartbeat
+                )
+            finally:
+                _phase_deadline[0] = None
 
         t0 = time.perf_counter()
         out, warm_times, ok = full_pass(states, RUNG_BUDGET_S)

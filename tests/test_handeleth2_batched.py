@@ -2,6 +2,7 @@
 rotation, window growth, determinism."""
 
 import numpy as np
+import pytest
 
 from wittgenstein_tpu.engine import replicate_state
 from wittgenstein_tpu.protocols.handeleth2 import (
@@ -80,3 +81,34 @@ class TestBatchedHandelEth2:
         ca = np.asarray(a.proto["contrib_total"])
         b = net.run_ms_batched(states, 9000)
         assert (np.asarray(b.proto["contrib_total"]) == ca).all()
+
+    @pytest.mark.slow
+    def test_desynchronized_start_oracle_parity(self):
+        """desynchronized_start > 0 (HandelEth2.init: each node's periodic
+        tasks begin at delta_start + 1): per-node shifted beat clocks match
+        the oracle's per-node task registration exactly — identical aggDone
+        and contributions after 20 s, and the deltas actually spread."""
+        p = make_params(desynchronized_start=17)
+        o = HandelEth2(p)
+        o.init()
+        deltas = np.array([n.delta_start for n in o.network().all_nodes])
+        assert deltas.max() > deltas.min()  # the config desynchronizes
+        o.network().run_ms(20000)
+        o_ad = np.array([n.agg_done for n in o.network().all_nodes])
+        o_ct = np.array([n.contributions_total for n in o.network().all_nodes])
+
+        net, state = make_handeleth2(p)
+        assert (np.asarray(net.protocol.delta) == deltas).all()
+        out = net.run_ms(state, 20000)
+        assert (np.asarray(out.proto["agg_done"]) == o_ad).all()
+        b_ct = np.asarray(out.proto["contrib_total"])
+        assert (b_ct == o_ct).all(), (o_ct.mean(), b_ct.mean())
+
+        # batched replica path exercises the multi-residue beat gate
+        states = replicate_state(state, 2)
+        bb = net.run_ms_batched(states, 9000)
+        one = net.run_ms(state, 9000)
+        assert (
+            np.asarray(bb.proto["contrib_total"])[0]
+            == np.asarray(one.proto["contrib_total"])
+        ).all()

@@ -81,6 +81,11 @@ class BatchedHandelEth2(BatchedProtocol):
         # emission peer lists per level, -1 padded: [N, L, N/2]
         self.peers = jnp.asarray(roles["peers"], jnp.int32)
         self.pairing = jnp.asarray(roles["pairing"], jnp.int32)  # [N]
+        # per-node start offset (HandelEth2.init: periodic tasks begin at
+        # delta_start + 1); all beat tests run on the shifted clock t - delta
+        self.delta = jnp.asarray(
+            roles.get("delta", np.zeros(self.n_nodes, np.int32)), jnp.int32
+        )
 
     def msg_size(self, mtype: int) -> int:
         return 1
@@ -208,11 +213,12 @@ class BatchedHandelEth2(BatchedProtocol):
         proto = dict(state.proto)
 
         # ---- 2. process start/stop beat (every PERIOD_TIME) ----------------
-        beat_start = live & (t >= 1) & ((t - 1) % PERIOD_TIME == 0)
+        tb = t - self.delta  # per-node shifted clock (desynchronized start)
+        beat_start = live & (tb >= 1) & ((tb - 1) % PERIOD_TIME == 0)
         proto = self._start_stop(state, proto, beat_start)
 
         # ---- 3. dissemination beat (every period_duration_ms) --------------
-        beat_diss = live & (t >= 1) & ((t - 1) % p.period_duration_ms == 0)
+        beat_diss = live & (tb >= 1) & ((tb - 1) % p.period_duration_ms == 0)
         proto, ems = self._dissemination(state, proto, beat_diss)
 
         state = state._replace(proto=proto)
@@ -225,7 +231,8 @@ class BatchedHandelEth2(BatchedProtocol):
         t = state.time
         live = ~state.down
         proto = dict(state.proto)
-        beat_ver = live & (t >= 1) & ((t - 1) % self.pairing == 0)
+        tb = t - self.delta
+        beat_ver = live & (tb >= 1) & ((tb - 1) % self.pairing == 0)
         proto = self._select(state, proto, beat_ver)
         return state._replace(proto=proto)
 
@@ -637,10 +644,6 @@ def make_handeleth2(
     """Host-side construction from the oracle init (reception + emission
     ranks use the same JavaRandom stream)."""
     params = params or HandelEth2Parameters()
-    if params.desynchronized_start:
-        raise NotImplementedError(
-            "batched HandelEth2 runs all beats in phase (delta_start=0)"
-        )
     oracle = HandelEth2(params)
     oracle.init()
     nodes = oracle.network().all_nodes
@@ -661,16 +664,26 @@ def make_handeleth2(
         [max(1, getattr(nd, "node_pairing_time", params.pairing_time)) for nd in nodes],
         np.int32,
     )
-    roles = {"reception_ranks": rr, "peers": peers, "pairing": pairing}
+    delta = np.array([nd.delta_start for nd in nodes], np.int32)
+    roles = {
+        "reception_ranks": rr,
+        "peers": peers,
+        "pairing": pairing,
+        "delta": delta,
+    }
     latency = registry_network_latencies.get_by_name(params.network_latency_name)
     city_index = getattr(latency, "city_index", None)
     cols = build_node_columns(nodes, city_index)
     proto = BatchedHandelEth2(params, roles)
-    # beat gating: tick_beat fires at t ≡ 1 (mod period_duration_ms); the
-    # PERIOD_TIME start/stop beat must land on the same grid
+    # beat gating: node i's tick_beat fires at t ≡ 1 + delta_i
+    # (mod period_duration_ms); the PERIOD_TIME start/stop beat lands on the
+    # same grid.  With desynchronized starts the residue set is the distinct
+    # (1 + delta_i) values — if that covers the whole period, run_ms_batched
+    # falls back to the ungated vmap path on its own.
     if PERIOD_TIME % params.period_duration_ms == 0:
-        proto.BEAT_PERIOD = params.period_duration_ms
-        proto.BEAT_RESIDUES = (1 % params.period_duration_ms,)
+        pd = params.period_duration_ms
+        proto.BEAT_PERIOD = pd
+        proto.BEAT_RESIDUES = tuple(sorted({(1 + int(d)) % pd for d in delta}))
         # send_ctr compensation: _dissemination emits P*(nl-1) ring
         # emissions per call (one per (process, level))
         proto.BEAT_SEND_CALLS = P * (proto.nl - 1)
