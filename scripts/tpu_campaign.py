@@ -42,7 +42,15 @@ CHUNK_LIMIT_S = 180  # ... and a device chunk past this (watchdog is ~100 s)
 NODES = int(os.environ.get("WITT_CAMPAIGN_NODES", "4096"))
 REPLICA_LADDER = (4, 8, 16, 32, 64)
 SIM_MS = 1000
-CHUNK_MS = 100  # one program per rung; 100-tick chunks stayed short in r3/r4
+# one program per rung.  20-tick chunks: per-chunk readback overhead is
+# just tunnel RTT, while the worst-case in-flight device program (the
+# thing the ~100 s RPC watchdog kills) shrinks 5x vs the r3 100-tick
+# choice — the 4096x4 first-chunk hang showed 100 ticks can run minutes.
+CHUNK_MS = int(os.environ.get("WITT_CAMPAIGN_CHUNK_MS", "20"))
+if CHUNK_MS <= 0 or SIM_MS % CHUNK_MS != 0:
+    raise SystemExit(
+        f"WITT_CAMPAIGN_CHUNK_MS={CHUNK_MS} must be a positive divisor of {SIM_MS}"
+    )
 RUNG_BUDGET_S = 900  # full-pass cost cap per rung (checked between chunks)
 
 
@@ -120,7 +128,7 @@ def campaign() -> None:
         # second XLA program and a second worker-side compile, and a long
         # compile is itself watchdog-killable (the r4 campaign crash).
         n_chunks = SIM_MS // CHUNK_MS
-        run = jax.jit(lambda s: net.run_ms_batched(s, CHUNK_MS))
+        run = jax.jit(lambda s: net.run_ms_batched(s, CHUNK_MS, True))
 
         # the compile is one long blocking call: log its START so the
         # supervisor's mtime watchdog doesn't count tracing+compile as

@@ -189,6 +189,27 @@ class TestBatchedHandel:
         out2 = net.run_ms_batched(states, 3000)
         assert (np.asarray(out2.done_at) == done).all()
 
+    def test_stop_when_done_same_outcome(self):
+        """stop_when_done exits the lockstep loop once every replica's
+        aggregation completed: identical done_at and final clock, fewer
+        (or equal) post-done sends — on the beat-gated path and, via
+        run_ms, the ungated one."""
+        net, state = make_handel(make_params(node_count=32, threshold=30))
+        states = replicate_state(state, 3, seeds=[3, 4, 5])
+        full = net.run_ms_batched(states, 3000)
+        early = net.run_ms_batched(states, 3000, True)
+        assert (np.asarray(early.done_at) == np.asarray(full.done_at)).all()
+        assert (np.asarray(early.done_at) > 0).all()
+        assert (np.asarray(early.time) == np.asarray(full.time)).all()
+        assert (
+            np.asarray(early.msg_sent).sum() <= np.asarray(full.msg_sent).sum()
+        )
+
+        e1 = net.run_ms(state, 3000, True)
+        f1 = net.run_ms(state, 3000)
+        assert (np.asarray(e1.done_at) == np.asarray(f1.done_at)).all()
+        assert int(e1.time) == int(f1.time)
+
     def test_desynchronized_start(self):
         p = make_params(node_count=32, threshold=30, desynchronized_start=100)
         net, state = make_handel(p)

@@ -354,13 +354,24 @@ class BatchedNetwork:
         return state
 
     # -- the loop ------------------------------------------------------------
-    @functools.partial(jax.jit, static_argnums=(0, 2))
-    def run_ms(self, state: SimState, ms: int) -> SimState:
-        """Advance `ms` simulated milliseconds (ticks [time, time+ms))."""
+    @functools.partial(jax.jit, static_argnums=(0, 2, 3))
+    def run_ms(self, state: SimState, ms: int, stop_when_done: bool = False) -> SimState:
+        """Advance `ms` simulated milliseconds (ticks [time, time+ms)).
+
+        stop_when_done=True adds the protocol's `all_done` predicate to the
+        loop condition: once the observable outcome is decided (e.g. every
+        live Handel node aggregated), remaining ticks are skipped and the
+        clock jumps to `end` — the batched analog of the oracle DES going
+        quiescent when no events remain.  Post-done side effects (periodic
+        re-offers' traffic counters) are NOT simulated, so keep the default
+        for traffic-parity runs."""
         end = state.time + ms
 
         def cond(s):
-            return s.time < end
+            c = s.time < end
+            if stop_when_done:
+                c = c & ~self.protocol.all_done(s)
+            return c
 
         def body(s):
             return self._step_jump(s, end)
@@ -368,8 +379,10 @@ class BatchedNetwork:
         state = lax.while_loop(cond, body, state)
         return state._replace(time=end)
 
-    @functools.partial(jax.jit, static_argnums=(0, 2))
-    def run_ms_batched(self, states: SimState, ms: int) -> SimState:
+    @functools.partial(jax.jit, static_argnums=(0, 2, 3))
+    def run_ms_batched(
+        self, states: SimState, ms: int, stop_when_done: bool = False
+    ) -> SimState:
         """vmapped run over the leading replica axis — the TPU replacement
         for RunMultipleTimes' sequential reseeded loop.
 
@@ -378,7 +391,13 @@ class BatchedNetwork:
         advance time in lockstep, so the tick index is replica-uniform and
         tick_beat can be guarded by a real lax.cond — off-beat ticks skip
         the periodic work instead of executing it masked (a vmapped
-        lax.cond would execute both branches)."""
+        lax.cond would execute both branches).
+
+        stop_when_done stops the LOCKSTEP loop once every replica's
+        all_done holds (see run_ms).  On the ungated fallback path the
+        flag is semantics-only: vmapped while_loops mask finished lanes
+        rather than skip them, so the body runs until the SLOWEST replica
+        finishes either way."""
         proto = self.protocol
         period, residues = proto.BEAT_PERIOD, proto.BEAT_RESIDUES
         if (
@@ -387,7 +406,7 @@ class BatchedNetwork:
             or residues is None
             or len(residues) >= period
         ):
-            return jax.vmap(lambda s: self.run_ms(s, ms))(states)
+            return jax.vmap(lambda s: self.run_ms(s, ms, stop_when_done))(states)
 
         step_v = jax.vmap(self._step_core)
         beat_v = jax.vmap(lambda s: proto.tick_beat(self, s))
@@ -414,7 +433,20 @@ class BatchedNetwork:
             s = post_v(s)
             return s._replace(time=s.time + 1)
 
-        return lax.fori_loop(0, ms, body, states)
+        if not stop_when_done:
+            return lax.fori_loop(0, ms, body, states)
+
+        def w_cond(carry):
+            i, s = carry
+            return (i < ms) & ~jnp.all(jax.vmap(proto.all_done)(s))
+
+        def w_body(carry):
+            i, s = carry
+            return i + 1, body(i, s)
+
+        i_fin, states = lax.while_loop(w_cond, w_body, (jnp.int32(0), states))
+        # normalize the lockstep clocks to the full horizon, like run_ms
+        return states._replace(time=states.time + (ms - i_fin))
 
 
 def replicate_state(state: SimState, n_replicas: int, seeds=None) -> SimState:
