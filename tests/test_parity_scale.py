@@ -82,6 +82,48 @@ class TestHandel1024:
         assert displaced <= 0.45 * received, (displaced, received)
 
 
+class TestHandel4096:
+    def test_oracle_quantile_parity_north_star(self):
+        """THE north-star config (BASELINE.md): Handel BLS aggregation at
+        4096 nodes.  P10/P50/P90 of time-to-threshold vs the oracle DES,
+        plus the displacement-rate pin at full scale."""
+        from wittgenstein_tpu.protocols.handel import HandelParameters
+        from wittgenstein_tpu.protocols.handel_batched import make_handel
+
+        from test_handel_batched import batched_done_at, oracle_done_at
+
+        n = 4096
+        p = HandelParameters(
+            node_count=n,
+            threshold=int(n * 0.99),
+            pairing_time=3,
+            level_wait_time=20,
+            dissemination_period_ms=10,
+            fast_path=10,
+            nodes_down=0,
+            node_builder_name=NB,
+            network_latency_name=NL,
+        )
+        o = oracle_done_at(p, range(2), 2500)
+        assert (o > 0).all()
+        b = batched_done_at(p, 2, 2500)
+        assert (b > 0).all()
+        oq = np.percentile(o, [10, 50, 90])
+        bq = np.percentile(b, [10, 50, 90])
+        rel = np.abs(bq - oq) / oq
+        assert (rel <= 0.08).all(), (oq, bq, rel)
+
+        # displacement stays a bounded fraction of traffic at 4096 — full
+        # window, NO early exit: the ratio must measure the same quantity
+        # as the 1024 pin (post-done re-offer traffic included)
+        net, state = make_handel(p)
+        out = net.run_ms(state, 2500)
+        assert (np.asarray(out.done_at) > 0).all()
+        displaced = int(out.proto["displaced"])
+        received = int(np.asarray(out.msg_received).sum())
+        assert displaced <= 0.45 * received, (displaced, received)
+
+
 class TestGSF2048:
     def test_oracle_quantile_parity(self):
         from wittgenstein_tpu.protocols.gsf import GSFSignature, GSFSignatureParameters
