@@ -100,13 +100,22 @@ class TestBatchedEthPow:
         assert int(out.n_blocks) <= 8
         assert int(out.overflowed) > 0  # loudly recorded, not silent
 
-    def test_agent_miners_rejected(self):
-        """The stepwise RL bridge stays oracle-only; selfish miners don't."""
+    def test_agent_variant_accepted_csv_logger_rejected(self):
+        """The RL agent runs batched (ethpow_env); only the CSV decision
+        logger stays oracle-only."""
+        net = BatchedEthPow(
+            ETHPoWParameters(
+                number_of_miners=10,
+                byz_class_name="ETHMinerAgent",
+                byz_mining_ratio=0.3,
+            )
+        )
+        assert net.agent and not net.selfish
         with pytest.raises(NotImplementedError):
             BatchedEthPow(
                 ETHPoWParameters(
                     number_of_miners=10,
-                    byz_class_name="ETHMinerAgent",
+                    byz_class_name="ETHAgentMiner",
                     byz_mining_ratio=0.3,
                 )
             )

@@ -1,0 +1,84 @@
+"""BatchedMinerEnv: the vectorized RL bridge over batched ETHPoW."""
+
+import numpy as np
+
+from wittgenstein_tpu.protocols.ethpow import ETHPoWParameters
+from wittgenstein_tpu.protocols.ethpow_env import BatchedMinerEnv
+
+
+def make_env(**kw):
+    p = ETHPoWParameters(
+        number_of_miners=10,
+        byz_class_name="ETHMinerAgent",
+        byz_mining_ratio=0.25,
+    )
+    kw.setdefault("n_replicas", 4)
+    kw.setdefault("decision_ms", 1000)
+    return BatchedMinerEnv(p, **kw)
+
+
+class TestBatchedMinerEnv:
+    def test_reset_and_shapes(self):
+        env = make_env()
+        obs = env.reset()
+        for key in (
+            "advance",
+            "secret_advance",
+            "lag",
+            "n_withheld",
+            "reward_ratio",
+            "mined_block",
+            "other_new_head",
+            "other_private_head",
+        ):
+            assert obs[key].shape == (4,), key
+        assert (obs["n_withheld"] == 0).all()
+        assert (obs["reward_ratio"] == 0).all()
+
+    def test_withhold_then_release(self):
+        """Withholding accumulates private blocks (secret advance grows
+        somewhere across replicas); a big release flushes them and the
+        agent's blocks reach the public chain."""
+        env = make_env()
+        env.reset()
+        hold = np.zeros(4, np.int32)
+        wh_seen = 0
+        for _ in range(60):  # 60 sim-seconds of pure withholding
+            obs, _, _ = env.step(hold)
+            wh_seen = max(wh_seen, int(obs["n_withheld"].max()))
+        assert wh_seen > 0  # the 25%-hashpower agent mined something
+        # auto-release keeps the private chain bounded by what the public
+        # chain hasn't overtaken: secret_advance == n_withheld
+        assert (obs["secret_advance"] == obs["n_withheld"]).all()
+
+        obs, reward, _ = env.step(np.full(4, 64, np.int32))  # release all
+        assert (obs["n_withheld"] == 0).all()
+        # released blocks joined the public fork-choice; over 60+ s the
+        # agent's share of the winning chain is visible somewhere
+        assert reward.max() > 0
+
+    def test_determinism(self):
+        env1, env2 = make_env(), make_env()
+        env1.reset()
+        env2.reset()
+        acts = np.asarray([0, 1, 2, 3], np.int32)
+        for _ in range(5):
+            o1, r1, _ = env1.step(acts)
+            o2, r2, _ = env2.step(acts)
+        assert (r1 == r2).all()
+        for k in o1:
+            assert (np.asarray(o1[k]) == np.asarray(o2[k])).all(), k
+
+    def test_honest_policy_tracks_hashpower(self):
+        """Always-release-immediately ≈ honest mining: the agent's share
+        of the winning chain lands near its 25% hashpower (wide band —
+        short chains are noisy)."""
+        env = make_env(n_replicas=8, decision_ms=2000)
+        env.reset()
+        release_all = np.full(8, 64, np.int32)
+        for _ in range(150):  # 300 sim-seconds ≈ ~23 blocks per replica
+            obs, reward, _ = env.step(release_all)
+        # pooled over replicas: mean share within a generous band
+        assert 0.10 <= float(reward.mean()) <= 0.45, reward
+        # honest play holds no secrets by the end of a release step
+        assert (obs["n_withheld"] == 0).all()

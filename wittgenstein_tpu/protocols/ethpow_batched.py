@@ -42,8 +42,12 @@ blocks instead of broadcasting them (ETHMiner.java:165-171) — is kept
 verbatim.  Same-beat simultaneity approximation: of several external
 blocks arriving in one 10 ms beat only the best (max total difficulty) is
 processed as `on_received_block`; the others can't have beaten it for
-other_miners_head anyway.  Agent/CSV miners (stepwise RL bridge) stay on
-the oracle.
+other_miners_head anyway.  The RL agent miner (ETHMinerAgent.java) runs
+batched too — withhold-always mining, best-head tracking and the
+overtaken-block auto-release live in `_agent_receive`, explicit releases
+in `agent_apply_action`, and the vectorized decision loop (R lockstep
+replicas per policy step) is `ethpow_env.BatchedMinerEnv`; only the CSV
+decision logger (ETHAgentMiner.java) stays oracle-side.
 
 Deliberate simplifications (the spike's documented scope — see
 docs/batched_blockchain_design.md for the fork-choice design note and the
@@ -87,8 +91,16 @@ TOTAL_HASH_POWER_GHS = 200 * 1024  # ETHPoW.java:72
 BEAT_MS = 10
 SELFISH_ID = 1  # the bad node is always at pos 1 (ETHPoW.java:78-87)
 
-# byz_class_name -> batched strategy id; agent miners stay oracle-only
-BATCHED_BYZ = {"ETHMiner": 0, "ETHSelfishMiner": 1, "ETHSelfishMiner2": 2}
+# byz_class_name -> batched strategy id (pos-1 miner, ETHPoW.java:78-87)
+BATCHED_BYZ = {
+    "ETHMiner": 0,
+    "ETHSelfishMiner": 1,
+    "ETHSelfishMiner2": 2,
+    # the stepwise RL bridge, vectorized: mining/receive semantics live
+    # here (withhold + auto-release of overtaken blocks); the decision
+    # loop is ethpow_env.BatchedMinerEnv
+    "ETHMinerAgent": 3,
+}
 
 
 @jax.tree_util.register_pytree_node_class
@@ -147,13 +159,14 @@ class BatchedEthPow:
             if key not in BATCHED_BYZ:
                 raise NotImplementedError(
                     f"batched ETHPoW supports {sorted(BATCHED_BYZ)} as "
-                    "byz_class_name; agent/CSV miners (stepwise RL bridge) "
-                    "run on the oracle (protocols/ethpow.py)"
+                    "byz_class_name; the CSV decision logger (ETHAgentMiner) "
+                    "runs on the oracle (protocols/ethpow.py)"
                 )
             self.variant = BATCHED_BYZ[key]
         else:
             self.variant = None
         self.selfish = self.variant in (1, 2)
+        self.agent = self.variant == 3
         self.params = params
         self.b_max = b_max
         self.m = params.number_of_miners
@@ -307,6 +320,122 @@ class BatchedEthPow:
         )
         return omh, withheld, arrival, lose
 
+    # -- agent receive phase (ETHMinerAgent.java:187-204) --------------------
+    def _release_rows(self, s: EthPowState, t, rel_mask, tag):
+        """Arrival rows for every block in rel_mask: one send event per
+        released block, destinations at t+1+latency (action_send_oldest ->
+        send_block -> send_all); the producer's own entry is untouched."""
+        m, b = self.m, self.b_max
+        mids = jnp.arange(m, dtype=jnp.int32)
+        bids = jnp.arange(b, dtype=jnp.int32)
+        ev = hash32(s.seed, t, bids, tag)  # [B]
+        to_idx = jnp.broadcast_to(mids[None, :], (b, m))
+        delta = pseudo_delta(to_idx, ev[:, None])
+        lat = vec_latency(
+            self.latency,
+            self.static,
+            jnp.full((b * m,), SELFISH_ID, jnp.int32),
+            to_idx.reshape(-1),
+            delta.reshape(-1),
+        ).reshape(b, m)
+        # min() keeps the producer's own earlier arrival and is idempotent
+        rows = jnp.minimum(s.arrival, t + 1 + lat)
+        return jnp.where(rel_mask[:, None], rows, s.arrival)
+
+    def _agent_receive(self, s: EthPowState, t):
+        """on_received_block for the RL agent at pos 1: other_miners_head =
+        best(omh, rcv); withheld blocks the public chain has overtaken
+        (youngest.height <= omh.height) auto-release oldest-first — the
+        loop at ETHMinerAgent.java:196-203, i.e. the private chain's
+        bottom segment with height <= height[omh] (releases in that loop
+        never advance omh).  A scalar release walk like the selfish
+        variants': zero iterations on the (typical) beat with nothing
+        overtaken."""
+        sm = SELFISH_ID
+        m = self.m
+        mids = jnp.arange(m, dtype=jnp.int32)
+        prod, par, hgt, td = s.producer, s.parent, s.height, s.td
+        arr_sm = s.arrival[:, sm]
+        newly = (arr_sm > t - BEAT_MS) & (arr_sm <= t) & (prod != sm) & (prod >= 0)
+        rcv = jnp.argmax(jnp.where(newly, td, -1.0)).astype(jnp.int32)
+        act = jnp.any(newly) & (td[rcv] > td[s.omh])
+        omh = jnp.where(act, rcv, s.omh)
+
+        # walk from the private tip down to the highest overtaken block,
+        # then release it and its withheld ancestors
+        start = lax.while_loop(
+            lambda i: (i > 0) & (hgt[i] > hgt[omh]),
+            lambda i: par[i],
+            jnp.maximum(s.pmb, 0),
+        )
+        sm_vec = jnp.full(m, sm, jnp.int32)
+
+        def rl_cond(c):
+            wh_, ar_, i = c
+            return (i > 0) & wh_[i]
+
+        def rl_body(c):
+            wh_, ar_, i = c
+            ev = hash32(s.seed, t, i, jnp.int32(0xA6E7))
+            dlt = pseudo_delta(mids, ev)
+            lat = vec_latency(self.latency, self.static, sm_vec, mids, dlt)
+            row = jnp.where(mids == sm, ar_[i, sm], t + 1 + lat)
+            return (wh_.at[i].set(False), ar_.at[i].set(row), par[i])
+
+        withheld, arrival, _ = lax.while_loop(
+            rl_cond, rl_body, (s.withheld, s.arrival, start)
+        )
+        return omh, withheld, arrival
+
+    def agent_apply_action(self, s: EthPowState, k) -> EthPowState:
+        """send_mined_blocks(k) (ETHMinerAgent.java:68-88): release the k
+        OLDEST withheld private blocks.  omh advances to the highest
+        released block that overtakes it (action_send_oldest_block_mined);
+        a fully-honored k with a live private chain restarts mining on the
+        head with a fresh candidate (startNewMining, ethpow.py:529-532);
+        an emptied private chain clears private_miner_block."""
+        sm = SELFISH_ID
+        hgt = s.height
+        kk = jnp.maximum(jnp.int32(k), 0)
+        wh_h = jnp.where(s.withheld, hgt, INT32_MAX)
+        low = jnp.min(wh_h)
+        rel = s.withheld & (hgt < low + kk)
+        arrival = self._release_rows(s, s.time, rel, jnp.int32(0xAC70))
+        withheld = s.withheld & ~rel
+        top = jnp.argmax(jnp.where(rel, hgt, -1)).astype(jnp.int32)
+        omh = jnp.where(jnp.any(rel) & (hgt[top] > hgt[s.omh]), top, s.omh)
+
+        # how_many reached 0 (k fully honored) + still mining + private
+        # block live -> start_new_mining(head): restamp the candidate
+        restart = (
+            (jnp.sum(rel.astype(jnp.int32)) == kk)
+            & s.mining[sm]
+            & (s.pmb >= 0)
+        )
+        head = s.head[sm]
+        father = s.father.at[sm].set(jnp.where(restart, head, s.father[sm]))
+        cand_time = s.cand_time.at[sm].set(
+            jnp.where(restart, s.time, s.cand_time[sm])
+        )
+        new_diff = self._calc_difficulty(
+            s.diff[head], s.b_time[head], s.height[head], s.time
+        )
+        cand_diff = s.cand_diff.at[sm].set(
+            jnp.where(restart, new_diff, s.cand_diff[sm])
+        )
+
+        pmb = jnp.where(jnp.any(withheld), s.pmb, -1)
+        return dataclasses.replace(
+            s,
+            arrival=arrival,
+            withheld=withheld,
+            omh=omh,
+            pmb=pmb,
+            father=father,
+            cand_time=cand_time,
+            cand_diff=cand_diff,
+        )
+
     # -- one 10 ms beat ------------------------------------------------------
     def _beat(self, s: EthPowState) -> EthPowState:
         t = s.time
@@ -332,6 +461,9 @@ class BatchedEthPow:
         # losing the race)
         if self.selfish:
             omh, withheld, arrival_in, lose = self._selfish_receive(s, t, new_head)
+        elif self.agent:
+            omh, withheld, arrival_in = self._agent_receive(s, t)
+            lose = None
         else:
             omh, withheld, arrival_in = s.omh, s.withheld, s.arrival
             lose = None
@@ -380,9 +512,10 @@ class BatchedEthPow:
         lat = vec_latency(self.latency, static, from_idx, to_idx, delta)
         arr = (t + 1 + lat).reshape(m, m)
         arr = jnp.where(jnp.eye(m, dtype=bool), t, arr)  # own block now
-        if self.selfish:
-            # the selfish miner withholds: its block reaches only itself
-            # (send_mined_block returns False, ETHSelfishMiner.java:46-48)
+        if self.selfish or self.agent:
+            # the private miner withholds: its block reaches only itself
+            # (send_mined_block returns False, ETHSelfishMiner.java:46-48,
+            # ETHMinerAgent.java:63-65)
             sm_row = jnp.where(mids == SELFISH_ID, t, INT32_MAX)
             arr = arr.at[SELFISH_ID].set(sm_row)
         arrival = arrival_in.at[slot].set(arr, mode="drop")
@@ -395,20 +528,21 @@ class BatchedEthPow:
         # 2-deep own chain, adopt it as other_miners_head and clear the
         # withheld set (send_all_mined's hook-drop quirk)
         pmb = s.pmb
-        if self.selfish:
+        if self.selfish or self.agent:
             sm = SELFISH_ID
             k = idx[sm]
             mined_ok = success[sm] & fits[sm]
+            withheld = withheld.at[jnp.where(mined_ok, k, b)].set(True, mode="drop")
+            pmb = jnp.where(mined_ok, k, s.pmb)
+        if self.selfish:
             f_sm = father[sm]
             hk = s.height[f_sm] + 1
             td_k = new_td[sm]
-            withheld = withheld.at[jnp.where(mined_ok, k, b)].set(True, mode="drop")
             delta_pm = hk - (s.height[omh] - 1)
             depth2 = (s.producer[f_sm] == sm) & (s.producer[s.parent[f_sm]] != sm)
             publish0 = mined_ok & (delta_pm == 0) & depth2
             omh = jnp.where(publish0 & (td_k >= s.td[omh]), k, omh)
             withheld = jnp.where(publish0, jnp.zeros_like(withheld), withheld)
-            pmb = jnp.where(mined_ok, k, s.pmb)
 
         return EthPowState(
             time=t + BEAT_MS,

@@ -83,9 +83,15 @@ def run_sweep(
     replicas: int = 4,
     sim_ms: int = 3000,
     seed0: int = 0,
+    stop_when_done: bool = False,
 ) -> List[BasicStats]:
     """Run every (config x replica) in stacked batches; one BasicStats per
-    config, reduced over live nodes of all its replicas."""
+    config, reduced over live nodes of all its replicas.
+
+    stop_when_done skips ticks once EVERY stacked row's aggregation
+    completed (engine early exit) — doneAt stats are unchanged, but the
+    msgRcv/msgFiltered counters stop at completion, so leave it off when
+    comparing traffic against the oracle."""
     results: Dict[int, BasicStats] = {}
 
     # group by traced-program shape so each group is ONE compiled sweep
@@ -104,7 +110,7 @@ def run_sweep(
                     st._replace(seed=st.seed * 0 + (seed0 + 1000 * i + r))
                 )
         stacked = stack_states(states)
-        out = net.run_ms_batched(stacked, sim_ms)
+        out = net.run_ms_batched(stacked, sim_ms, stop_when_done)
 
         down = np.asarray(out.down)
         done = np.asarray(out.done_at)
