@@ -30,7 +30,12 @@ import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(ROOT, "tpu_campaign.jsonl")
+OUT = os.environ.get(
+    "WITT_CAMPAIGN_OUT", os.path.join(ROOT, "tpu_campaign.jsonl")
+)
+# dry-run the CHILD logic on the CPU backend (separate OUT file!) so a
+# recovered chip never meets untested campaign code
+ALLOW_CPU = os.environ.get("WITT_CAMPAIGN_ALLOW_CPU") == "1"
 PROBE_TIMEOUT_S = 150
 
 sys.path.insert(0, ROOT)
@@ -109,9 +114,11 @@ def campaign() -> None:
     from wittgenstein_tpu.engine import replicate_state
     from wittgenstein_tpu.protocols.handel_batched import make_handel
 
+    if ALLOW_CPU:
+        jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]
     log({"event": "campaign_start", "device": str(dev), "kind": dev.device_kind})
-    if dev.platform != "tpu":
+    if dev.platform != "tpu" and not ALLOW_CPU:
         log({"event": "abort", "reason": f"platform {dev.platform} != tpu"})
         return
 
@@ -215,7 +222,7 @@ def campaign() -> None:
 
     if results:
         best = max(results, key=lambda x: x["sims_per_sec"])
-        log({"event": "campaign_best", **best})
+        log({**best, "event": "campaign_best"})
     log({"event": "campaign_end"})
 
 
