@@ -33,9 +33,13 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.environ.get(
     "WITT_CAMPAIGN_OUT", os.path.join(ROOT, "tpu_campaign.jsonl")
 )
-# dry-run the CHILD logic on the CPU backend (separate OUT file!) so a
-# recovered chip never meets untested campaign code
+# dry-run the CHILD logic on the CPU backend so a recovered chip never
+# meets untested campaign code.  Requires an explicit WITT_CAMPAIGN_OUT:
+# CPU rungs in the real jsonl would poison done_rungs() resume keys and
+# campaign_best with CPU numbers.
 ALLOW_CPU = os.environ.get("WITT_CAMPAIGN_ALLOW_CPU") == "1"
+if ALLOW_CPU and not os.environ.get("WITT_CAMPAIGN_OUT"):
+    raise SystemExit("WITT_CAMPAIGN_ALLOW_CPU=1 requires WITT_CAMPAIGN_OUT")
 PROBE_TIMEOUT_S = 150
 
 sys.path.insert(0, ROOT)
@@ -234,6 +238,10 @@ def _mtime() -> float:
 
 
 def supervise() -> None:
+    if ALLOW_CPU:
+        # the dry-run flag is child-only: a supervisor would hand a live
+        # TPU to a CPU-pinned child and record CPU rungs as real
+        raise SystemExit("WITT_CAMPAIGN_ALLOW_CPU is only valid with --run")
     deadline = time.time() + float(os.environ.get("WITT_CAMPAIGN_HOURS", "10")) * 3600
     child_err = open(os.path.join(ROOT, "campaign_child.log"), "ab")
     while time.time() < deadline:

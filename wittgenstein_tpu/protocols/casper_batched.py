@@ -597,7 +597,7 @@ class BatchedCasper(BatchedProtocol):
 def make_casper(
     params: Optional[CasperParameters] = None,
     max_heights: int = 24,
-    capacity: int = 1 << 14,
+    capacity: Optional[int] = None,
     seed: int = 0,
     byz_variant: str = "wf",
     byz_delay: int = 0,
@@ -652,6 +652,13 @@ def make_casper(
     city_index = getattr(latency, "city_index", None)
     cols = build_node_columns(nodes, city_index)
     proto = BatchedCasper(params, roles, max_heights, byz_variant, byz_delay)
+    if capacity is None:
+        # the peak in-flight load is one committee's attestation broadcast
+        # ([apr x N] messages, all delivered well inside the 8 s slot) plus
+        # scheduled self-messages; a full ring DROPS new sends, so auto-size
+        # to 1.5 waves (the default 20x4 config keeps the old 1<<14)
+        wave = apr * n + 4 * n
+        capacity = max(1 << 14, 1 << int(np.ceil(np.log2(1.5 * wave))))
     net = BatchedNetwork(proto, latency, n, capacity=capacity)
     state = net.init_state(cols, seed=seed, proto=proto.proto_init(n))
     return net, state
