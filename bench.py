@@ -474,11 +474,13 @@ def main() -> None:
                 ],
                 "workload": (
                     "handel-full: windowed scoring, Byzantine attack machinery,"
-                    " fastPath, per-node pairing.  r4 second pass: send-time"
-                    " xor_shuffle, due-pair delivery, beat-gated dissemination"
-                    " (bit-identical engine semantics, ~3x faster tick than"
-                    " the r4 first pass; not comparable to the r1/r2 lite"
-                    " engine)"
+                    " fastPath, per-node pairing.  r4: send-time xor_shuffle,"
+                    " due-pair delivery, beat-gated dissemination, 20-tick"
+                    " readback-synced chunks, and the DES-quiescence early"
+                    " exit (stop_when_done) — ticks after every replica"
+                    " aggregates are skipped, like the oracle's empty event"
+                    " queue; done_at parity pinned by test.  Not comparable"
+                    " to the r1/r2 lite engine"
                 ),
                 "probe": probe,
                 "bench_error": bench_error,
