@@ -157,3 +157,17 @@ class TestByzVariants:
         assert abs(int(bh.max()) - int(oh.max())) <= 1
         bp0 = o.bps[0]
         assert int(np.asarray(out.proto["byz_skipped"]).max()) == bp0.skipped
+
+
+def test_ring_capacity_autosizes_to_attestation_wave():
+    """One committee broadcast is [apr x N] messages; a full ring DROPS new
+    sends, so make_casper sizes the ring to 1.5 waves (the silent-capping
+    bug behind the r4 1024-validator sweep failure).  Default config keeps
+    the original 1<<14 (compile-cache stable)."""
+    net, _ = make_casper(CasperParameters(), max_heights=12)
+    assert net.capacity == 1 << 14
+    net, _ = make_casper(
+        CasperParameters(cycle_length=4, attesters_per_round=256),
+        max_heights=12,
+    )
+    assert net.capacity == 1 << 19
