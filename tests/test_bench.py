@@ -1,0 +1,67 @@
+"""bench.py headline-record contract: the parity field (VERDICT r4 #8)
+and the campaign-fallback provenance path.  Pure record assembly — no
+simulation runs, stays in the fast tier."""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+import bench
+
+
+def _sample_result():
+    return {
+        "sims_per_sec": 2.0,
+        "compile_s": 10.0,
+        "run_s": 2.0,
+        "chunk_ms": 20,
+    }
+
+
+class TestHeadlineRecord:
+    def test_parity_field_present_and_explicit(self):
+        rec = bench._headline(
+            4096, 8, _sample_result(), "tpu", "TPU v5 lite",
+            {"platform": "tpu"}, None, [], oracle=0.0145,
+        )
+        par = rec["parity"]
+        # stop_when_done preserves the deliverable (done_at) but not the
+        # post-done traffic counters — the record must say so explicitly
+        assert par["done_at"] is True
+        assert par["traffic_counters"] is False
+        assert "stop_when_done" in par["note"]
+
+    def test_headline_core_contract(self):
+        rec = bench._headline(
+            4096, 8, _sample_result(), "tpu", "TPU v5 lite",
+            {"platform": "tpu"}, None, [], oracle=0.0145,
+        )
+        for key in ("metric", "value", "unit", "vs_baseline"):
+            assert key in rec, key
+        assert rec["metric"] == "handel4096_sims_per_sec_chip"
+        assert rec["value"] == 2.0
+        assert rec["vs_baseline"] == round(2.0 / 0.0145, 3)
+        assert rec["provenance"] == "measured live by this bench run"
+        json.dumps(rec)  # one JSON line, serializable
+
+    def test_campaign_rung_parsing(self, tmp_path):
+        p = tmp_path / "campaign.jsonl"
+        lines = [
+            {"event": "campaign_start", "device": "TPU v5 lite0", "kind": "TPU v5 lite"},
+            {"event": "tpu_down"},
+            {"event": "rung", "nodes": 4096, "replicas": 8, "sims_per_sec": 1.5,
+             "run_s": 5.3, "chunk_ms": 20},
+            {"event": "rung", "nodes": 4096, "replicas": 16, "sims_per_sec": 2.5,
+             "run_s": 6.4, "chunk_ms": 20},
+            {"event": "campaign_end"},
+        ]
+        p.write_text("".join(json.dumps(r) + "\n" for r in lines))
+        rungs, kind = bench._campaign_tpu_rungs(str(p))
+        assert len(rungs) == 2
+        assert kind == "TPU v5 lite"
+        best = max(rungs, key=lambda x: x["sims_per_sec"])
+        assert (best["nodes"], best["replicas"]) == (4096, 16)
+
+    def test_campaign_missing_file_is_empty(self, tmp_path):
+        rungs, kind = bench._campaign_tpu_rungs(str(tmp_path / "nope.jsonl"))
+        assert rungs == []

@@ -204,3 +204,163 @@ class TestBatchedSelfishMiners:
         n = np.asarray(out.n_blocks) - 1  # minus genesis
         assert (n >= np.asarray(lens)).all()
         assert n.mean() > 1.2 * np.mean(lens)
+
+
+def _oracle_agent_withhold(seeds, horizon, ratio=0.45):
+    """Oracle ETHMinerAgent driven with the keep-withholding policy (never
+    send_mined_blocks): only the auto-release of overtaken blocks
+    (ETHMinerAgent.java:196-203) publishes anything.  Returns the agent's
+    mean public-chain revenue ratio + chain length (observer head walk)."""
+    rs, lens = [], []
+    for seed in seeds:
+        p = ETHPoWParameters(
+            number_of_miners=10, byz_class_name="ETHMinerAgent", byz_mining_ratio=ratio
+        )
+        pr = ETHPoW(p)
+        pr.network().rd.set_seed(seed)
+        pr.init()
+        byz = pr.get_byzantine_node()
+        while pr.network().time < horizon:
+            byz.go_next_step()
+        cur = pr.network().observer.head
+        own = tot = 0
+        while cur.producer is not None:
+            own += int(cur.producer is byz)
+            tot += 1
+            cur = cur.parent
+        rs.append(own / tot)
+        lens.append(tot)
+    return float(np.mean(rs)), float(np.mean(lens))
+
+
+class TestAgentSemantics:
+    """ETHMinerAgent Java-exact semantics (ADVICE r4): the sendMinedBlocks
+    post-decrement restart quirk (ETHMinerAgent.java:79-84) and the
+    privateMinerBlock lifecycle on auto-release."""
+
+    def _sim(self, b_max=64):
+        return BatchedEthPow(
+            ETHPoWParameters(
+                number_of_miners=10,
+                byz_class_name="ETHMinerAgent",
+                byz_mining_ratio=0.45,
+            ),
+            b_max=b_max,
+        )
+
+    def _private_chain_state(self, sim, n_priv=2, t=1000):
+        """Hand-built state: the agent withholds n_priv blocks 1..n_priv on
+        top of genesis, mining on the private tip (candidate stamped 500)."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from wittgenstein_tpu.protocols.ethpow_batched import INT32_MAX, SELFISH_ID
+
+        s = sim.init_state()
+        sm = SELFISH_ID
+        mids = jnp.arange(sim.m, dtype=jnp.int32)
+        for i in range(1, n_priv + 1):
+            row = jnp.where(mids == sm, 0, INT32_MAX).astype(jnp.int32)
+            s = dataclasses.replace(
+                s,
+                parent=s.parent.at[i].set(i - 1),
+                height=s.height.at[i].set(s.height[0] + i),
+                producer=s.producer.at[i].set(sm),
+                td=s.td.at[i].set(s.td[i - 1] + s.diff[0]),
+                arrival=s.arrival.at[i].set(row),
+                withheld=s.withheld.at[i].set(True),
+            )
+        return dataclasses.replace(
+            s,
+            time=jnp.int32(t),
+            n_blocks=jnp.int32(n_priv + 1),
+            pmb=jnp.int32(n_priv),
+            head=s.head.at[sm].set(n_priv),
+            father=s.father.at[sm].set(n_priv),
+            cand_time=s.cand_time.at[sm].set(500),
+            mining=s.mining.at[sm].set(True),
+        )
+
+    def test_apply_action_no_restamp_on_k0_or_full_release(self):
+        from wittgenstein_tpu.protocols.ethpow_batched import SELFISH_ID
+
+        sim = self._sim()
+        s = self._private_chain_state(sim, n_priv=2)
+        # k=0 (keep withholding): nothing released, no candidate restamp
+        out0 = sim.agent_apply_action(s, 0)
+        assert int(out0.cand_time[SELFISH_ID]) == 500
+        assert int(out0.pmb) == 2
+        assert int(np.sum(np.asarray(out0.withheld))) == 2
+        # k=2 = |withheld| (fully honored): all released, pmb cleared,
+        # but Java's post-decrement leaves howMany=-1 -> NO restamp
+        out2 = sim.agent_apply_action(s, 2)
+        assert int(np.sum(np.asarray(out2.withheld))) == 0
+        assert int(out2.pmb) == -1
+        assert int(out2.cand_time[SELFISH_ID]) == 500
+
+    def test_apply_action_restamps_only_on_avail_plus_one(self):
+        from wittgenstein_tpu.protocols.ethpow_batched import SELFISH_ID
+
+        sim = self._sim()
+        s = self._private_chain_state(sim, n_priv=2)
+        # k=3 = |withheld|+1: the ONE case Java's howMany ends at 0 ->
+        # start_new_mining(head) restamps the candidate at the current time
+        out3 = sim.agent_apply_action(s, 3)
+        assert int(np.sum(np.asarray(out3.withheld))) == 0
+        assert int(out3.pmb) == -1
+        assert int(out3.cand_time[SELFISH_ID]) == int(s.time)
+
+    def test_auto_release_clears_pmb_when_withheld_empties(self):
+        """A public block overtaking the private tip auto-releases it
+        (ETHMinerAgent.java:196-203); once minedToSend empties the oracle
+        nulls privateMinerBlock — the batched beat must too (ADVICE r4)."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from wittgenstein_tpu.protocols.ethpow_batched import INT32_MAX, SELFISH_ID
+
+        sim = self._sim()
+        s = self._private_chain_state(sim, n_priv=1)
+        # external block (miner 2) at height genesis+2 with a higher td,
+        # arriving at the agent exactly this beat
+        t = int(s.time)
+        row = jnp.full(sim.m, t, jnp.int32)
+        s = dataclasses.replace(
+            s,
+            parent=s.parent.at[2].set(0),
+            height=s.height.at[2].set(s.height[0] + 2),
+            producer=s.producer.at[2].set(2),
+            td=s.td.at[2].set(s.td[1] + 2 * s.diff[0]),
+            arrival=s.arrival.at[2].set(row),
+            n_blocks=jnp.int32(3),
+        )
+        out = sim._beat(s)
+        assert int(np.sum(np.asarray(out.withheld))) == 0  # released
+        assert int(out.pmb) == -1  # privateMinerBlock = null
+        # the released block reached the network: someone other than the
+        # agent eventually receives block 1
+        arr = np.asarray(out.arrival)[1]
+        others = [i for i in range(sim.m) if i != SELFISH_ID]
+        assert (arr[others] < np.iinfo(np.int32).max).any()
+
+    @pytest.mark.slow
+    def test_agent_withhold_parity(self):
+        """Oracle-vs-batched parity for byz_class_name=ETHMinerAgent under
+        the keep-withholding policy: public-chain revenue ratio and chain
+        length agree (same tolerances as the selfish parity test)."""
+        from wittgenstein_tpu.protocols.ethpow_batched import (
+            chain_producers,
+            selfish_revenue_ratio,
+        )
+
+        horizon = 1_200_000
+        o_ratio, o_len = _oracle_agent_withhold(range(6), horizon)
+        sim = self._sim(b_max=512)
+        out = sim.run_ms_batched(replicate_ethpow(sim.init_state(), 12), horizon)
+        ratios = [selfish_revenue_ratio(out, r) for r in range(12)]
+        lens = [len(chain_producers(out, r)) for r in range(12)]
+        b_ratio = float(np.mean(ratios))
+        assert abs(b_ratio - o_ratio) <= 0.15, (b_ratio, o_ratio)
+        assert abs(np.mean(lens) - o_len) <= 0.15 * o_len, (np.mean(lens), o_len)

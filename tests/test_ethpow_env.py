@@ -82,3 +82,14 @@ class TestBatchedMinerEnv:
         assert 0.10 <= float(reward.mean()) <= 0.45, reward
         # honest play holds no secrets by the end of a release step
         assert (obs["n_withheld"] == 0).all()
+
+
+def test_decision_ms_must_align_to_beat():
+    """The transition advances in 10 ms beats; a non-multiple decision_ms
+    would overshoot every step and drift the decision grid (ADVICE r4)."""
+    import pytest
+
+    for bad in (15, 0, -10, 7):
+        with pytest.raises(ValueError):
+            make_env(decision_ms=bad)
+    make_env(decision_ms=20)  # multiples stay accepted

@@ -519,15 +519,24 @@ class ETHMinerAgent(ETHMiner):
         return False
 
     def send_mined_blocks(self, how_many: int) -> None:
-        """(ETHMinerAgent.java:68-88)."""
+        """(ETHMinerAgent.java:68-88).  The Java loop is
+        `while (howMany-- > 0 && !minedToSend.isEmpty())`: the
+        post-decrement leaves howMany at -1 after a fully-honored k (and
+        after k=0), so the `howMany == 0` restart below fires ONLY when k
+        exceeded the available withheld blocks by exactly one (including
+        k=1 against an empty set) — never on k=0 and never on a
+        fully-honored release.  Kept bit-exact here and mirrored by the
+        batched path (ethpow_batched.agent_apply_action)."""
         if self.decision_needed == 0:
             print(
                 f"no action needed: howMany={how_many}, advance={self.get_advance()}, "
                 f"secretAdvance={self.get_secret_advance()}"
             )
-        while how_many > 0 and self.mined_to_send:
-            self.action_send_oldest_block_mined()
+        while True:
             how_many -= 1
+            if how_many < 0 or not self.mined_to_send:
+                break
+            self.action_send_oldest_block_mined()
         if how_many == 0 and self.in_mining is not None and self.private_miner_block is not None:
             self.start_new_mining(self.head)
         if not self.mined_to_send:

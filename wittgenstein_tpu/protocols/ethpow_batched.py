@@ -391,9 +391,12 @@ class BatchedEthPow:
         """send_mined_blocks(k) (ETHMinerAgent.java:68-88): release the k
         OLDEST withheld private blocks.  omh advances to the highest
         released block that overtakes it (action_send_oldest_block_mined);
-        a fully-honored k with a live private chain restarts mining on the
-        head with a fresh candidate (startNewMining, ethpow.py:529-532);
-        an emptied private chain clears private_miner_block."""
+        an emptied private chain clears private_miner_block.  Java's
+        post-decrement loop leaves howMany at -1 after a fully-honored k,
+        so the startNewMining restamp fires ONLY when k exceeded the
+        available blocks by exactly one — never on k=0 (the env's default
+        keep-withholding action) and never on a fully-honored release
+        (ethpow.py send_mined_blocks, kept bit-exact to the reference)."""
         sm = SELFISH_ID
         hgt = s.height
         kk = jnp.maximum(jnp.int32(k), 0)
@@ -405,10 +408,11 @@ class BatchedEthPow:
         top = jnp.argmax(jnp.where(rel, hgt, -1)).astype(jnp.int32)
         omh = jnp.where(jnp.any(rel) & (hgt[top] > hgt[s.omh]), top, s.omh)
 
-        # how_many reached 0 (k fully honored) + still mining + private
-        # block live -> start_new_mining(head): restamp the candidate
+        # Java howMany ends at 0 iff k == |withheld| + 1 -> only then
+        # start_new_mining(head) restamps the candidate (see docstring)
+        avail = jnp.sum(s.withheld.astype(jnp.int32))
         restart = (
-            (jnp.sum(rel.astype(jnp.int32)) == kk)
+            (kk == avail + 1)
             & s.mining[sm]
             & (s.pmb >= 0)
         )
@@ -528,12 +532,19 @@ class BatchedEthPow:
         # 2-deep own chain, adopt it as other_miners_head and clear the
         # withheld set (send_all_mined's hook-drop quirk)
         pmb = s.pmb
+        if self.agent:
+            # the auto-release loop (ETHMinerAgent.java:196-203) goes
+            # through sendMinedBlocks(1), whose final guard nulls
+            # privateMinerBlock once minedToSend empties; without this a
+            # stale pmb would pass agent_apply_action's pmb>=0 gate where
+            # the oracle sees private_miner_block=None (ADVICE r4)
+            pmb = jnp.where(jnp.any(withheld), pmb, jnp.int32(-1))
         if self.selfish or self.agent:
             sm = SELFISH_ID
             k = idx[sm]
             mined_ok = success[sm] & fits[sm]
             withheld = withheld.at[jnp.where(mined_ok, k, b)].set(True, mode="drop")
-            pmb = jnp.where(mined_ok, k, s.pmb)
+            pmb = jnp.where(mined_ok, k, pmb)
         if self.selfish:
             f_sm = father[sm]
             hk = s.height[f_sm] + 1

@@ -69,6 +69,16 @@ class BatchedMinerEnv:
             params = ETHPoWParameters(byz_class_name="ETHMinerAgent")
         if not (params.byz_class_name or "").endswith("ETHMinerAgent"):
             raise ValueError("BatchedMinerEnv requires byz_class_name=ETHMinerAgent")
+        from .ethpow_batched import BEAT_MS
+
+        if decision_ms <= 0 or decision_ms % BEAT_MS != 0:
+            # the transition advances in BEAT_MS beats until time >= end: a
+            # non-multiple would overshoot every step and silently drift
+            # the decision grid off the documented per-step coverage
+            raise ValueError(
+                f"decision_ms={decision_ms} must be a positive multiple of "
+                f"the {BEAT_MS} ms mining beat"
+            )
         self.net = BatchedEthPow(params, b_max=b_max, seed=seed)
         self.n_replicas = n_replicas
         self.decision_ms = decision_ms
