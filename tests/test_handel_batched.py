@@ -90,17 +90,31 @@ class TestBatchedHandel:
         assert (done[down] == 0).all()
 
     def test_oracle_quantile_parity(self):
-        """P10/P50/P90 of time-to-threshold within 8% of the oracle DES
-        (replaces the old ±25% mean-only check)."""
+        """P10/P50/P90 of time-to-threshold within 4% of the oracle DES.
+
+        Residual attribution (r5, scripts/parity_residual.py + ablations
+        at 64 oracle runs x 64-128 replicas, sampling noise < 0.4%):
+        the r4-era 8% residual was displacement loss — 25% of received
+        traffic displaced at CHANNEL_DEPTH=8 cost +3.8%/+7.7% on P50/P90.
+        D=32 (now the Handel default) cuts it to ~10% displaced and
+        |gap| <= 2.7%.  What remains: +2.7% P90 = residual displacement
+        (D=64 halves it again), -2.1% P10 = lockstep variance compression
+        (simultaneous same-ms delivery narrows the CDF vs the sequential
+        DES) — the intrinsic approximation of a time-stepped engine.  The
+        rank construction is NOT a term: the r5 PRP rewrite (reference
+        shuffle order statistics) left all three quantiles unchanged."""
         p = make_params(node_count=64, threshold=63)
-        o = oracle_done_at(p, range(12), 2000)
+        # 24 oracle runs / 32 replicas: cluster-bootstrap quantile SE at
+        # this sample size is ~0.7%, leaving >1.8 sigma of headroom over
+        # the measured worst-case 2.7% gap under the 4% bound
+        o = oracle_done_at(p, range(24), 2000)
         assert (o > 0).all()
-        b = batched_done_at(p, 16, 2000)
+        b = batched_done_at(p, 32, 2000)
         assert (b > 0).all()
         oq = np.percentile(o, [10, 50, 90])
         bq = np.percentile(b, [10, 50, 90])
         rel = np.abs(bq - oq) / oq
-        assert (rel <= 0.08).all(), (oq, bq, rel)
+        assert (rel <= 0.04).all(), (oq, bq, rel)
 
     @pytest.mark.parametrize("attack", ["byzantine_suicide", "hidden_byzantine"])
     def test_attack_parity(self, attack):

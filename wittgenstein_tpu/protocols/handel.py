@@ -51,6 +51,10 @@ class HandelParameters(WParameters):
     window_maximum: int = 128
     window_increase_factor: float = 2.0
     window_decrease_factor: float = 4.0
+    # batched-engine knob (no oracle effect): in-flight channel slots per
+    # (receiver, level); None = the engine default.  Trades HBM for lower
+    # message displacement — see BatchedHandel.CHANNEL_DEPTH
+    channel_depth: Optional[int] = None
 
     def __post_init__(self):
         from ._aggregation import normalize_agg_params

@@ -69,10 +69,12 @@ Semantics carried exactly (Handel.java refs):
 Distribution-parity approximations (deliberate, each noted inline):
   * reception ranks: the reference shuffles one global [N] permutation
     per receiver (setReceivingRanks :940-948); here rank(i, l, rel) is a
-    counter-hash bijection over the level block scaled to the same [0, N)
-    range.  The post-verification demotion (receptionRanks[from] +=
-    nodeCount, :826-830) becomes a +N penalty whenever the sender's
-    individual sig is already verified.
+    keyed pseudorandom PERMUTATION of [0, N) per receiver evaluated at
+    the sender's absolute id (see _rank) — globally distinct ranks whose
+    level-block order statistics match the reference's shuffle.  The
+    post-verification demotion (receptionRanks[from] += nodeCount,
+    :826-830) becomes a +N penalty whenever the sender's individual sig
+    is already verified.
   * emission order (:991-1013) is a counter-hash offset + cycling cursor
     per level rather than the rank-derived emission lists; finished-peer
     bookkeeping (levelFinished/finishedPeers) is not tracked.
@@ -108,9 +110,24 @@ from .handel import HandelParameters
 
 class BatchedHandel(BitsetAggBase):
     CAND_SLOTS = 8  # K: arrived verification candidates per (receiver, level)
+    # D=32 arrival slots (vs the base class's 8): the r5 residual
+    # decomposition (scripts/parity_residual.py + parity_ablate.py)
+    # measured displacement as the dominant CDF bias — 25% of received
+    # traffic displaced at D=8 costs +3.8%/+7.7% on P50/P90 done_at;
+    # D=32 cuts displacement to ~10% and the residual to |2.7|% worst-
+    # case.  Delivery cost is O(1) in D (only 2 slots can be due per
+    # tick); the price is channel memory, ~3.7x on in_sig — ~106 MiB per
+    # 4096-node replica, still 32+ replicas inside a v5e chip's HBM.
+    CHANNEL_DEPTH = 32
 
     def __init__(self, params: HandelParameters):
         self.params = params
+        if params.channel_depth is not None:
+            if params.channel_depth <= 0:
+                raise ValueError(
+                    f"channel_depth={params.channel_depth} must be positive"
+                )
+            self.CHANNEL_DEPTH = params.channel_depth  # instance override
         self._init_geometry(params.node_count)
 
     def msg_size(self, mtype: int) -> int:
@@ -121,22 +138,36 @@ class BatchedHandel(BitsetAggBase):
 
     # -- ranks ---------------------------------------------------------------
     def _rank(self, seed, ids, level, rel):
-        """Counter-hash stand-in for the reference's global reception-rank
-        permutation (setReceivingRanks, Handel.java:940-948): a bijection
-        over the level block scaled to the [0, N) range so windowIndex +
-        currWindowSize comparisons see reference-like rank spacing.
+        """Stand-in for the reference's global reception-rank permutation
+        (setReceivingRanks, Handel.java:940-948): one pseudorandom
+        PERMUTATION of [0, N) per receiver, evaluated at the sender's
+        absolute id.  Three keyed multiply/xorshift/add rounds over the
+        n-bit domain — each round is bijective mod 2^n (odd multiplier,
+        xorshift, add), so ranks are globally distinct per receiver and a
+        level block's ranks have the order statistics of a uniform draw
+        WITHOUT replacement from [0, N), matching the reference's shuffle.
+        (The r4 stratified construction halved E[min rank] = windowIndex —
+        measured -2% doneAt bias; see scripts/parity_residual.py.)
 
         ids/level/rel broadcast together; level may be a static int or a
         stacked [.., L-1, ..] axis."""
         level = jnp.asarray(level, jnp.int32)
         bs = jnp.asarray(self.lv_bs)[level - 1]
         r0 = rel & (bs - 1)
-        mul = hash32(seed, ids, level, jnp.int32(0xA11CE)) | jnp.int32(1)
-        add = hash32(seed, ids, level, jnp.int32(0xBEEF))
-        perm = (r0 * mul + add) & (bs - 1)
-        gap = jnp.int32(self.n_nodes) // bs  # >= 2 for every level
-        jit = hash32(seed, ids, rel, level) & (gap - 1)
-        return perm * gap + jit
+        # sender's absolute id: level-l peers of receiver i are i ^ j for
+        # bit index j in [bs, 2*bs)
+        x = (jnp.asarray(ids, jnp.int32) ^ (bs + r0)).astype(jnp.uint32)
+        mask = jnp.uint32(self.n_nodes - 1)
+        nbits = self.n_nodes.bit_length() - 1
+        s1 = max(1, nbits // 2)
+        x &= mask
+        for rnd in range(3):
+            mul = hash32(seed, ids, jnp.int32(0xA11CE + rnd)).astype(jnp.uint32) | jnp.uint32(1)
+            add = hash32(seed, ids, jnp.int32(0xBEEF + rnd)).astype(jnp.uint32)
+            x = (x * mul) & mask
+            x = x ^ (x >> jnp.uint32(s1 + (rnd & 1)))
+            x = (x + add) & mask
+        return x.astype(jnp.int32)
 
     def _dyn_full_block(self, bs, w_pad: int):
         """[..,] dynamic block sizes -> [.., w_pad] all-ones-below-bs words."""

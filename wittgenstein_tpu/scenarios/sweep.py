@@ -140,30 +140,51 @@ def default_params(
     nodes: int,
     dead_ratio: Optional[float] = None,
     tor: Optional[float] = None,
+    period_time: Optional[int] = None,
+    extra_cycle: Optional[int] = None,
     desynchronized_start: Optional[int] = None,
     byzantine_suicide: bool = False,
     hidden_byzantine: bool = False,
+    loc: Optional[str] = None,
+    level_wait_time: Optional[int] = None,
+    fast_path: Optional[int] = None,
+    window_initial: Optional[int] = None,
 ) -> HandelParameters:
-    """HandelScenarios.defaultParams (HandelScenarios.java:92-122): the
-    canonical scenario configuration."""
-    from ..core.registries import RANDOM, builder_name
+    """HandelScenarios.defaultParams (HandelScenarios.java:65-122), full
+    signature.  loc=None keeps the repo battery's original RANDOM
+    placement with the default latency; "AWS"/"CITIES"/"RANDOM" mirror
+    the reference's Location -> (builder, latency) mapping (:84-90)."""
+    from ..core.registries import AWS, CITIES, RANDOM, builder_name
 
     dead_ratio = 0.10 if dead_ratio is None else dead_ratio
     dead = int(nodes * dead_ratio)
     threshold = int(nodes * (1.0 - dead_ratio) * 0.99)
     threshold = max(2, min(threshold, nodes - dead))
+    if loc is None:
+        nb_name = builder_name(RANDOM, True, tor or 0.0)
+        lat_name = None
+    else:
+        # the reference builds RegistryNodeBuilders.name(loc, false, tor)
+        nb_name = builder_name(loc, False, tor or 0.0)
+        lat_name = {
+            AWS: "AwsRegionNetworkLatency",
+            CITIES: "NetworkLatencyByCityWJitter",
+            RANDOM: "NetworkLatencyByDistanceWJitter",
+        }[loc]
+    kw = {} if window_initial is None else {"window_initial": window_initial}
     return HandelParameters(
         node_count=nodes,
         threshold=threshold,
         pairing_time=4,
-        level_wait_time=50,
-        extra_cycle=10,
-        dissemination_period_ms=20,
-        fast_path=10,
+        level_wait_time=50 if level_wait_time is None else level_wait_time,
+        extra_cycle=10 if extra_cycle is None else extra_cycle,
+        dissemination_period_ms=20 if period_time is None else period_time,
+        fast_path=10 if fast_path is None else fast_path,
         nodes_down=dead,
-        node_builder_name=builder_name(RANDOM, True, tor or 0.0),
-        network_latency_name=None,
+        node_builder_name=nb_name,
+        network_latency_name=lat_name,
         desynchronized_start=desynchronized_start or 0,
         byzantine_suicide=byzantine_suicide,
         hidden_byzantine=hidden_byzantine,
+        **kw,
     )
