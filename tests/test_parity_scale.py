@@ -44,14 +44,23 @@ class TestHandel1024:
             node_builder_name=NB,
             network_latency_name=NL,
         )
-        o = oracle_done_at(p, range(3), 2500)
+        # r5 measured residual at these sample sizes' precision (6 seeds x
+        # 1024 nodes, 12 replicas; scripts/parity_residual.py method):
+        # rel_gap = (-2.4%, -1.0%, +0.7%).  P50/P90 meet the +-2% target;
+        # P10's -2.4% is the lockstep variance-compression term
+        # (simultaneous same-ms delivery narrows the CDF) — intrinsic to a
+        # time-stepped engine, bounded at 3%.  Displacement, the r4-era
+        # dominant bias, is handled by CHANNEL_DEPTH=32 (see
+        # test_handel_batched.test_oracle_quantile_parity for the full
+        # attribution).
+        o = oracle_done_at(p, range(6), 2500)
         assert (o > 0).all()
-        b = batched_done_at(p, 4, 2500)
+        b = batched_done_at(p, 12, 2500)
         assert (b > 0).all()
         oq = np.percentile(o, [10, 50, 90])
         bq = np.percentile(b, [10, 50, 90])
         rel = np.abs(bq - oq) / oq
-        assert (rel <= 0.08).all(), (oq, bq, rel)
+        assert (rel <= np.array([0.03, 0.02, 0.02])).all(), (oq, bq, rel)
 
     def test_displacement_measured_harmless(self):
         """Channel displacement is visible (proto['displaced']) and stays a

@@ -19,10 +19,17 @@ Both run identically on a virtual CPU mesh
 multi-host (DCN) — the mesh is the only thing that changes.
 """
 
-from .node_shard import run_ms_node_sharded, shard_state_by_node
+from .node_shard import (
+    enable_node_sharding,
+    node_shard_bytes,
+    run_ms_node_sharded,
+    shard_state_by_node,
+)
 from .replica_shard import shard_replicas, sharded_run_stats
 
 __all__ = [
+    "enable_node_sharding",
+    "node_shard_bytes",
     "run_ms_node_sharded",
     "shard_state_by_node",
     "shard_replicas",

@@ -54,6 +54,45 @@ from ..engine.rng import hash32, pseudo_delta
 from ..utils.javarand import JavaRandom
 
 
+def enable_node_sharding(net, mesh: Mesh, axis: str = "nodes",
+                         exchange_capacity: Optional[int] = None):
+    """Return a COPY of the engine whose aggregation-protocol send path
+    commits through the explicit all_to_all exchange
+    (BitsetAggBase._channel_commit_sharded) instead of GSPMD's
+    gather-prone scatter partitioning.  Copying gives the engine a fresh
+    jit-cache identity, so traces compiled for the mesh-less original can
+    never be replayed for the sharded run (run_ms is jitted with the
+    engine as an identity-keyed static argument).
+
+    exchange_capacity bounds the per-destination exchange bucket (see
+    _channel_commit_sharded: None = bit-exact worst-case capacity;
+    a bound trades rare counted displacement for O(P) less transient
+    exchange memory at large meshes)."""
+    import copy
+
+    net = copy.copy(net)
+    net.node_mesh = mesh
+    net.node_axis = axis
+    net.exchange_capacity = exchange_capacity
+    return net
+
+
+def node_shard_bytes(state, n: int):
+    """HBM proxy: {array_name: per_device_bytes} for every node-axis
+    array of a sharded state, from the ACTUAL addressable shards (what
+    the device really holds, not what the annotation promised)."""
+    out = {}
+
+    def visit(path, a):
+        if hasattr(a, "addressable_shards") and a.ndim >= 1 and a.shape[0] == n:
+            out[jax.tree_util.keystr(path)] = max(
+                s.data.nbytes for s in a.addressable_shards
+            )
+
+    jax.tree_util.tree_map_with_path(visit, state)
+    return out
+
+
 def shard_state_by_node(net, state, mesh: Mesh, axis: str = "nodes"):
     """Place ONE simulation's state onto the mesh with every [N, ...]
     array (leading dim == n_nodes) sharded over `axis` and everything
