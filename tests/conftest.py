@@ -27,6 +27,53 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 import pytest  # noqa: E402
 
+# -- fast-tier time budget (VERDICT r4 #7) ----------------------------------
+# The default run (-m "not slow") must stay inside an iteration-speed
+# budget; r4's fast tier silently grew to 43 minutes.  The gate sums the
+# durations pytest already measures and FAILS the session when the sum
+# exceeds WITT_FAST_BUDGET_S, so a budget regression cannot land quietly.
+# The sum is wall-clock of test phases (immune to collection idle time but
+# not machine load); the default leaves ~2x headroom over the measured
+# unloaded sum so load spikes don't flap the gate.  0 disables.
+try:
+    FAST_BUDGET_S = float(os.environ.get("WITT_FAST_BUDGET_S", "720"))
+except ValueError:
+    raise SystemExit(
+        f"WITT_FAST_BUDGET_S={os.environ['WITT_FAST_BUDGET_S']!r} must be "
+        "a number of seconds (0 disables the fast-tier budget gate)"
+    )
+_phase_seconds = [0.0]
+_slow_selected = [False]
+
+
+def pytest_runtest_logreport(report):
+    _phase_seconds[0] += report.duration
+
+
+def pytest_collection_modifyitems(config, items):
+    # the budget gate applies exactly when the slow tier is deselected —
+    # detected from the SELECTION itself, not the -m expression string
+    # (any rephrasing of "not slow" keeps the gate armed)
+    _slow_selected[0] = any(i.get_closest_marker("slow") for i in items)
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _fast_budget_gate(request):
+    """Fails the session (teardown error on the last test) when the fast
+    tier overran the budget — pytest_sessionfinish fires after the exit
+    code is decided, so a fixture finalizer is the enforcement point."""
+    yield
+    if _slow_selected[0] or FAST_BUDGET_S <= 0:
+        return
+    spent = _phase_seconds[0]
+    if spent > FAST_BUDGET_S:
+        pytest.fail(
+            f"FAST-TIER BUDGET EXCEEDED: {spent:.0f}s > {FAST_BUDGET_S:.0f}s "
+            "(WITT_FAST_BUDGET_S). Move the offenders (pytest "
+            "--durations=10) to the slow tier.",
+            pytrace=False,
+        )
+
 
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():

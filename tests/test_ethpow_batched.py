@@ -54,6 +54,7 @@ class TestBatchedEthPow:
         tip = int(np.argmax(td[: int(out.n_blocks)]))
         assert len(iv) == int(np.asarray(out.height)[tip]) - GENESIS_HEIGHT
 
+    @pytest.mark.slow
     def test_interval_distribution_parity(self):
         """Chain length, interval mean and P50/P75 within 12% of the oracle
         (measured ~1-5%; lower quantiles are dominated by sampling noise at

@@ -42,6 +42,7 @@ class TestBatchedGSF:
         assert (done > 0).all()
         assert bool(net.protocol.all_done(state))
 
+    @pytest.mark.slow
     def test_oracle_quantile_parity(self):
         """P10/P50/P90 of time-to-threshold within 8% of the oracle DES."""
         p = make_params()

@@ -89,6 +89,7 @@ class TestBatchedHandel:
         assert (done[~down] > 0).all()
         assert (done[down] == 0).all()
 
+    @pytest.mark.slow
     def test_oracle_quantile_parity(self):
         """P10/P50/P90 of time-to-threshold within 4% of the oracle DES.
 
@@ -116,6 +117,7 @@ class TestBatchedHandel:
         rel = np.abs(bq - oq) / oq
         assert (rel <= 0.04).all(), (oq, bq, rel)
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("attack", ["byzantine_suicide", "hidden_byzantine"])
     def test_attack_parity(self, attack):
         """Under each attack at 25% Byzantine, every live node still
