@@ -131,7 +131,7 @@ def test_only_two_slots_can_be_due():
     ss = d + 1
 
     def step_and_check(s, _):
-        in_key, due_all, _tpl = a._advance_channel(s.proto["in_key"])
+        in_key, due_all, _tpl = a._advance_channel(s.proto["in_key"], s.time)
         due3 = due_all.reshape(n, a.n_levels - 1, ss)
         sidx = lax.rem(s.time, jnp.asarray(d, jnp.int32))
         allowed = (jnp.arange(ss) == sidx) | (jnp.arange(ss) == d)

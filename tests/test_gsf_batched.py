@@ -44,19 +44,28 @@ class TestBatchedGSF:
 
     @pytest.mark.slow
     def test_oracle_quantile_parity(self):
-        """P10/P50/P90 of time-to-threshold within 8% of the oracle DES."""
+        """P10/P50/P90 of time-to-threshold within 3% of the oracle DES.
+
+        Measured -1.1%/-1.0%/+0.3% at 24 oracle runs x 32 replicas after
+        the r5 boundary-view selection fix (the r4-era -3% lead was
+        checkSigs firing on same-tick state).  GSF displacement is NOT a
+        parity term: cutting it D=8 -> D=32 left quantiles unchanged, so
+        the default depth stays 8.  The test runs the SAME sample sizes
+        as the measurement so the quoted values are what this computation
+        produces (deterministic per platform) — the 3% bound is ~2.7
+        sigma of headroom at ~0.7% quantile SE."""
         p = make_params()
-        o = oracle_done_at(p, range(12), 2000)
+        o = oracle_done_at(p, range(24), 2000)
         assert (o > 0).all()
         net, state = make_gsf(p)
-        states = replicate_state(state, 16)
+        states = replicate_state(state, 32)
         out = net.run_ms_batched(states, 2000)
         b = np.asarray(out.done_at).ravel()
         assert (b > 0).all()
         oq = np.percentile(o, [10, 50, 90])
         bq = np.percentile(b, [10, 50, 90])
         rel = np.abs(bq - oq) / oq
-        assert (rel <= 0.08).all(), (oq, bq, rel)
+        assert (rel <= 0.03).all(), (oq, bq, rel)
 
     def test_dead_nodes(self):
         p = make_params(nodes_down=16, threshold=40)

@@ -33,9 +33,11 @@ this turns ~12 unrolled per-level bodies x 4 phases (plus ~24 per-level
 send calls at ~700 StableHLO lines each) into ~4 bucket bodies and 2
 stacked sends, which is what lets the flagship config compile.
 
-Keys pack ((arrival - now) << rel_bits) | rel and are decremented once
-per tick, so the packing never overflows int32 for node counts up to
-MAX_NODES = 2^14; construction fails loudly beyond that.
+Keys pack (absolute_arrival << rel_bits) | rel — no per-tick countdown
+(see _advance_channel) — which bounds a sim at 2^(31-rel_bits) ms
+(524 s at 4096 nodes; sends beyond it are dropped into the displaced
+counter).  Node counts are capped at MAX_NODES = 2^14; construction
+fails loudly beyond that.
 """
 
 from __future__ import annotations
@@ -245,7 +247,7 @@ class BitsetAggBase(BatchedProtocol):
         return (word >> (pos & 31).astype(jnp.uint32)) & jnp.uint32(1)
 
     # -- channel layout ------------------------------------------------------
-    # in_key: [N, (L-1)*(D+1)] packed ((arrival-now)<<rel_bits | rel);
+    # in_key: [N, (L-1)*(D+1)] packed (arrival<<rel_bits | rel);
     # content per bucket i: proto[f"in_sig{i}"] = [N, nl*(D+1)*w_pad] flat,
     # level-major then slot then word.
 
@@ -285,11 +287,17 @@ class BitsetAggBase(BatchedProtocol):
             sigs,
         )
 
-    def _advance_channel(self, in_key):
-        """Decrement occupied keys one tick; returns (in_key, due, empty_tpl)."""
+    def _advance_channel(self, in_key, t):
+        """Due mask at tick t; returns (in_key, due, empty_tpl).
+
+        Keys pack the ABSOLUTE arrival (r5): the r4 relative packing
+        needed a full read-modify-write of the key array every tick just
+        to count down — at 4096 nodes x 32 replicas that decrement alone
+        was ~450 MB/tick of pure HBM traffic.  Absolute keys keep every
+        ordering property (min = earliest arrival, fresh-slot max =
+        newest offer) and make the due test a compare against t."""
         occupied = (in_key >= 0) & (in_key != INT32_MAX)
-        in_key = jnp.where(occupied, in_key - (1 << self.rel_bits), in_key)
-        due = occupied & ((in_key >> self.rel_bits) <= 0)
+        due = occupied & ((in_key >> self.rel_bits) <= t)
         empty_tpl = jnp.asarray(
             np.where(self._fresh_cols(), -1, INT32_MAX), jnp.int32
         )
@@ -357,10 +365,19 @@ class BitsetAggBase(BatchedProtocol):
             ),
         )
         rel = (to_idx ^ from_idx).astype(jnp.int32)
-        # time-relative arrival (>= 1): decremented per tick, so the packing
-        # never overflows int32
-        rel_arr = arrival - state.time
-        key = jnp.where(ok, (rel_arr << self.rel_bits) | rel, INT32_MAX)
+        # ABSOLUTE arrival packing (no per-tick countdown — see
+        # _advance_channel).  Sims running past the int32 packing horizon
+        # (2^(31-rel_bits) ms: 524 s at 4096 nodes, 128 s at the 16384
+        # cap) would overflow the shift; such sends are dropped and
+        # counted in proto["displaced"] so a too-long sim fails loudly in
+        # the displacement stats rather than corrupting arrival order.
+        # strictly below the last in-horizon ms: at the boundary arrival,
+        # a max-rel send would pack to exactly INT32_MAX — the empty-slot
+        # sentinel — and vanish uncounted
+        fits_t = arrival < (jnp.int32(1) << (31 - self.rel_bits)) - 1
+        time_overflow = jnp.sum((ok & ~fits_t).astype(jnp.int32))
+        ok = ok & fits_t
+        key = jnp.where(ok, (arrival << self.rel_bits) | rel, INT32_MAX)
 
         slot = lax.rem(arrival, jnp.int32(d))
 
@@ -385,6 +402,7 @@ class BitsetAggBase(BatchedProtocol):
                 mesh, net.node_axis, state, ok, to_idx, level, key, slot,
                 cnt_list, aux,
                 cap=getattr(net, "exchange_capacity", None),
+                time_overflow=time_overflow,
             )
 
         col = (level - 1) * ss + slot
@@ -403,7 +421,9 @@ class BitsetAggBase(BatchedProtocol):
         # still-pending occupant with a later arrival
         lost_entry = ok & ~winner & ~fresh_win
         evicted = winner & (prev != INT32_MAX) & (prev > key)
-        displaced = jnp.sum((lost_entry | evicted).astype(jnp.int32))
+        displaced = (
+            jnp.sum((lost_entry | evicted).astype(jnp.int32)) + time_overflow
+        )
 
         updates = dict(proto, in_key=new_key, displaced=proto["displaced"] + displaced)
 
@@ -435,7 +455,7 @@ class BitsetAggBase(BatchedProtocol):
     # -- node-sharded channel commit (explicit all_to_all exchange) ----------
     def _channel_commit_sharded(
         self, mesh, axis, state, ok, to_idx, level, key, slot, cnt_list, aux,
-        cap=None,
+        cap=None, time_overflow=0,
     ):
         """The channel commit of _send_stacked under node-axis sharding
         (SURVEY §7 / VERDICT r4 #4): each device owns N/P node rows of the
@@ -610,7 +630,7 @@ class BitsetAggBase(BatchedProtocol):
             updates[k] = res[1 + i]
         if have_aux:
             updates["in_aux"] = res[1 + nb]
-        updates["displaced"] = proto["displaced"] + res[-1]
+        updates["displaced"] = proto["displaced"] + res[-1] + time_overflow
         return state._replace(proto=updates)
 
     def _size_table(self):

@@ -331,7 +331,9 @@ class BatchedGSF(BitsetAggBase):
         rel_mask = (1 << self.rel_bits) - 1
         ss = self.CHANNEL_DEPTH + 1
 
-        in_key, due_all, empty_tpl = self._advance_channel(proto["in_key"])
+        in_key, due_all, empty_tpl = self._advance_channel(
+            proto["in_key"], state.time
+        )
         keys3 = self._keys_stacked(in_key)
         due3 = due_all.reshape(n, L - 1, ss)
         # only arrival slot (t mod D) and the fresh slot can be due at t
@@ -483,16 +485,28 @@ class BatchedGSF(BitsetAggBase):
         return state
 
     # -- tick phase 4: start verifications (checkSigs) -----------------------
-    def _select(self, net, state):
+    def _select(self, net, state, view=None):
         """Global best-scored candidate across levels
-        (GSFSignature.java:524-558)."""
+        (GSFSignature.java:524-558).
+
+        `view` (tick() passes it) holds the BOUNDARY state — candidates,
+        pending individuals and aggregates as of the end of the previous
+        tick — matching the reference's boundary-fired checkSigs
+        conditional task (GSFSignature.java:631-632, Network.java:533-565;
+        same mechanism as handel_batched._select).  Write-backs are
+        compare-and-clear (on the sender-rel key) / bit-clear merges.
+        Known imprecision, bounded by the periodic re-offers: the rel key
+        identifies the SENDER, not the entry, so a same-sender refresh
+        delivered this tick into a condemned/chosen slot index clears
+        with its predecessor (see the equivalent handel_batched note)."""
         proto = state.proto
+        v = proto if view is None else {**proto, **view}
         t = state.time
         n, L, K = self.n_nodes, self.n_levels, self.CAND_SLOTS
         ids = jnp.arange(n, dtype=jnp.int32)
 
         free = ~proto["ver_active"] & ~state.down & (t >= 1)
-        ver, indiv, pend = proto["ver"], proto["indiv"], proto["pend_ind"]
+        ver, indiv, pend = v["ver"], v["indiv"], v["pend_ind"]
 
         score_p, rel_p, pk_p, kidx_p = [], [], [], []
         key_pieces, pend_pieces = [], []
@@ -500,9 +514,9 @@ class BatchedGSF(BitsetAggBase):
             sl = slice(b.lo - 1, b.hi)
             lv = jnp.asarray(b.levels, jnp.int32)
             bs = self._bs_arr(b)
-            c_key = proto["cand_key"].reshape(n, L - 1, K)[:, sl, :]
-            c_pk = proto["cand_pk"].reshape(n, L - 1, K)[:, sl, :]
-            c_sig = self._sig_view(proto, i, K, prefix="cand_sig")
+            c_key = v["cand_key"].reshape(n, L - 1, K)[:, sl, :]
+            c_pk = v["cand_pk"].reshape(n, L - 1, K)[:, sl, :]
+            c_sig = self._sig_view(v, i, K, prefix="cand_sig")
             valid = c_key != INT32_MAX
             ver_b = self._blocks(ver, b)
             indiv_b = self._blocks(indiv, b)
@@ -514,8 +528,9 @@ class BatchedGSF(BitsetAggBase):
                 lv[None, :, None],
             )
             score = jnp.where(valid, score, -1)
-            # curation: drop worthless entries permanently
-            key_pieces.append(jnp.where(score == 0, INT32_MAX, c_key))
+            # curation: drop worthless entries permanently (condemn mask,
+            # applied compare-and-clear below)
+            key_pieces.append(valid & (score == 0))
             kbest = jnp.argmax(score, axis=2)
             sbest = jnp.take_along_axis(score, kbest[..., None], axis=2)[..., 0]
 
@@ -557,8 +572,17 @@ class BatchedGSF(BitsetAggBase):
         l_rel = self._level_stats(rel_p)
         l_pk = self._level_stats(pk_p)
         l_kidx = self._level_stats(kidx_p)
-        pend = self._assemble(pend, pend_pieces)
-        new_cand_key = jnp.concatenate(key_pieces, axis=1).reshape(n, (L - 1) * K)
+        # pend writes are pure bit-CLEARS on the view: merge as a clear
+        # mask onto the current array (a bit deliver(t) set stays set)
+        pend_after_view = self._assemble(pend, pend_pieces)
+        pend_clear = v["pend_ind"] & ~pend_after_view
+        pend = proto["pend_ind"] & ~pend_clear
+        # curation removal, compare-and-clear against the viewed key
+        condemn = jnp.concatenate(key_pieces, axis=1).reshape(n, (L - 1) * K)
+        cur_key = proto["cand_key"]
+        new_cand_key = jnp.where(
+            condemn & (cur_key == v["cand_key"]), INT32_MAX, cur_key
+        )
 
         # global best across levels; ascending-level iteration with strict >
         # in the original = first maximum wins = argmax
@@ -577,7 +601,7 @@ class BatchedGSF(BitsetAggBase):
         ver_sig = proto["ver_sig"]
         for i, b in enumerate(self.buckets):
             m = can & (best_level >= b.lo) & (best_level <= b.hi)
-            c_sig = self._sig_view(proto, i, K, prefix="cand_sig")
+            c_sig = self._sig_view(v, i, K, prefix="cand_sig")
             li = jnp.clip(best_level - b.lo, 0, b.nl - 1)
             c_lv = jnp.take_along_axis(c_sig, li[:, None, None, None], axis=1)[:, 0]
             safe_k = jnp.maximum(best_kidx, 0)
@@ -594,9 +618,13 @@ class BatchedGSF(BitsetAggBase):
         oh_full = self._onehot(best_rel, self.n_words)
         pend = jnp.where((can & sel_single)[:, None], pend & ~oh_full, pend)
 
-        # remove the chosen buffer candidate
+        # remove the chosen buffer candidate — compare-and-clear against
+        # the VIEWED key (best_rel is the chosen candidate's c_key value)
         flat_idx = (best_level - 1) * K + jnp.maximum(best_kidx, 0)
-        remove = can & ~sel_single
+        cur_at = new_cand_key.at[ids, flat_idx].get(
+            mode="fill", fill_value=INT32_MAX
+        )
+        remove = can & ~sel_single & (cur_at == best_rel)
         safe_row = jnp.where(remove, ids, n)
         new_cand_key = new_cand_key.at[safe_row, flat_idx].set(
             INT32_MAX, mode="drop"
@@ -621,9 +649,18 @@ class BatchedGSF(BitsetAggBase):
 
     # -- engine hooks --------------------------------------------------------
     def tick(self, net, state):
+        # boundary-view selection, like handel_batched.tick: checkSigs is
+        # a conditional task fired at the ms boundary, so it sees
+        # candidates/pending/aggregates as of the END of the previous tick
+        pre_cand = {
+            k: state.proto[k]
+            for k in ("cand_key", "cand_pk", "pend_ind")
+            + tuple(f"cand_sig{i}" for i in range(len(self.buckets)))
+        }
         state = self._channel_deliver(net, state)
+        pre_merge = {k: state.proto[k] for k in ("ver", "indiv")}
         state = self._commit(net, state)
-        state = self._select(net, state)
+        state = self._select(net, state, view={**pre_cand, **pre_merge})
         return state
 
     def all_done(self, state):

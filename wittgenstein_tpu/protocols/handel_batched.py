@@ -18,7 +18,7 @@ flat per-bucket 2D arrays, so nothing pays XLA's (8,128) tile padding.
 Three buffer stages per (receiver, level), mirroring the reference's
 message + toVerifyAgg + pairing pipeline:
 
-  1. in-flight channel: D slots keyed by ((arrival-now)<<rel_bits | rel),
+  1. in-flight channel: D slots keyed by (arrival<<rel_bits | rel),
      slot = arrival mod D, earliest arrival wins; displaced sends are
      counted in proto["displaced"] and lost — Handel's periodic
      dissemination re-offers content every period, exactly the redundancy
@@ -84,10 +84,11 @@ Distribution-parity approximations (deliberate, each noted inline):
   * same-ms deliveries are simultaneous; per-ms LIFO order inside the
     oracle's buckets has no analog.
 
-int32 packing guards: channel keys pack (arrival - now) << rel_bits | rel
-and candidate sort keys pack sizeIfIncluded * 4N + rank, so node_count is
-capped at 2^14 (16384) — far above the 4096-node north star — and
-construction fails loudly beyond it rather than overflowing.
+int32 packing guards: channel keys pack arrival << rel_bits | rel (sim
+horizon 2^(31-rel_bits) ms — 524 s at 4096 nodes; later sends drop into
+the displaced counter) and candidate sort keys pack sizeIfIncluded * 4N
++ rank, so node_count is capped at 2^14 (16384) — far above the
+4096-node north star — and construction fails loudly beyond it.
 """
 
 from __future__ import annotations
@@ -395,7 +396,7 @@ class BatchedHandel(BitsetAggBase):
         ss = D + 1
         lv_all = jnp.arange(1, L, dtype=jnp.int32)  # [L-1]
 
-        in_key, due_all, empty_tpl = self._advance_channel(proto["in_key"])
+        in_key, due_all, empty_tpl = self._advance_channel(proto["in_key"], t)
 
         keys3 = self._keys_stacked(in_key)  # [N, L-1, ss]
         due3 = due_all.reshape(n, L - 1, ss)
@@ -549,36 +550,53 @@ class BatchedHandel(BitsetAggBase):
         return state
 
     # -- tick phase 4: start new verifications (checkSigs) -------------------
-    def _select(self, net, state):
+    def _select(self, net, state, view=None):
         """bestToVerify per level + uniform cross-level choice + attacks +
-        window adaptation (Handel.java:566-630, 788-837)."""
+        window adaptation (Handel.java:566-630, 788-837).
+
+        `view` (tick() passes it) holds the BOUNDARY state — candidates
+        and aggregates as of the end of the previous tick — which is what
+        the reference's boundary-fired checkSigs sees.  Candidate
+        write-backs (curation removal, chosen-slot consumption) are
+        compare-and-clear against the viewed rank: a slot this tick's
+        delivery repopulated with a DIFFERENT-rank candidate survives.
+        Known imprecision, bounded by the periodic re-offers: delivery
+        re-sorts the K slots on arrival ticks, so a same-rank refresh
+        landing in a condemned/chosen slot index can be cleared with its
+        predecessor, and a moved chosen entry can survive for one
+        duplicate verification — a contributor to the documented P90
+        slow tail (see test_oracle_quantile_parity)."""
         p = self.params
         proto = state.proto
+        v = proto if view is None else {**proto, **view}
         t = state.time
         n, L, K = self.n_nodes, self.n_levels, self.CAND_SLOTS
         ids = jnp.arange(n, dtype=jnp.int32)
 
+        # busy gate from CURRENT state (a commit this tick frees the node,
+        # preserving the reference's pairing-time cadence); everything the
+        # selection SCORES on comes from the boundary view
         free = ~proto["ver_active"] & ~state.down & (t >= proto["start_at"] + 1)
         window = proto["window"]
         inc, ind, agg, bl, byz = (
-            proto["inc"],
-            proto["ind"],
-            proto["agg"],
-            proto["bl"],
+            v["inc"],
+            v["ind"],
+            v["agg"],
+            v["bl"],
             proto["byz"],
         )
 
         # per-level bests, one stacked body per bucket
         has_p, b_rank_p, b_rel_p, b_bad_p, b_kidx_p = [], [], [], [], []
         widx_p, insc_p = [], []
-        rank_pieces = []
+        condemn_pieces = []
         for i, b in enumerate(self.buckets):
             sl = slice(b.lo - 1, b.hi)
             lv = jnp.asarray(b.levels, jnp.int32)
             bs = jnp.asarray([self.bs[l] for l in b.levels], jnp.int32)
-            c_rank = proto["cand_rank"].reshape(n, L - 1, K)[:, sl, :]
-            c_rel = proto["cand_rel"].reshape(n, L - 1, K)[:, sl, :]
-            c_sig = self._sig_view(proto, i, K, prefix="cand_sig")
+            c_rank = v["cand_rank"].reshape(n, L - 1, K)[:, sl, :]
+            c_rel = v["cand_rel"].reshape(n, L - 1, K)[:, sl, :]
+            c_sig = self._sig_view(v, i, K, prefix="cand_sig")
             valid = c_rank != INT32_MAX
 
             inc_b = self._blocks(inc, b)
@@ -592,8 +610,9 @@ class BatchedHandel(BitsetAggBase):
             s = popcount_words(cc | ind_b[:, :, None, :])
             bl_bit = self._getbit(bl, c_rel)
             curated = valid & (s > popcount_words(inc_b)[:, :, None]) & (bl_bit == 0)
-            # permanent removal, like replaceToVerifyAgg (:612-618)
-            rank_pieces.append(jnp.where(curated, c_rank, INT32_MAX))
+            # permanent removal, like replaceToVerifyAgg (:612-618) —
+            # recorded as a condemn mask, applied compare-and-clear below
+            condemn_pieces.append(valid & ~curated)
 
             # windowIndex = min rank over the (pre-curation valid) queue
             window_index = jnp.min(
@@ -674,7 +693,14 @@ class BatchedHandel(BitsetAggBase):
         b_rel = self._level_stats(b_rel_p)
         b_bad = self._level_stats(b_bad_p)
         b_kidx = self._level_stats(b_kidx_p)
-        new_cand_rank = jnp.concatenate(rank_pieces, axis=1).reshape(n, (L - 1) * K)
+        # curation removal, compare-and-clear: only clear a slot that still
+        # holds the rank the view condemned (this tick's delivery may have
+        # repopulated it with a fresh candidate)
+        condemn = jnp.concatenate(condemn_pieces, axis=1).reshape(n, (L - 1) * K)
+        cur_rank = proto["cand_rank"]
+        new_cand_rank = jnp.where(
+            condemn & (cur_rank == v["cand_rank"]), INT32_MAX, cur_rank
+        )
 
         # chooseBestFromLevels: uniform among levels with a candidate (:788)
         vcount = jnp.sum(has, axis=1).astype(jnp.int32)
@@ -765,7 +791,7 @@ class BatchedHandel(BitsetAggBase):
         ver_sig = proto["ver_sig"]
         for i, b in enumerate(self.buckets):
             m = can & (level_sel >= b.lo) & (level_sel <= b.hi)
-            c_sig = self._sig_view(proto, i, K, prefix="cand_sig")
+            c_sig = self._sig_view(v, i, K, prefix="cand_sig")
             li = jnp.clip(level_sel - b.lo, 0, b.nl - 1)
             c_lv = jnp.take_along_axis(
                 c_sig, li[:, None, None, None], axis=1
@@ -784,9 +810,14 @@ class BatchedHandel(BitsetAggBase):
             ver_sig = jnp.where(m[:, None], sig_l, ver_sig)
 
         # remove the chosen buffer candidate (commit-time removal in the
-        # reference; removal at selection avoids double-verification)
+        # reference; removal at selection avoids double-verification).
+        # Compare-and-clear against the VIEWED rank: a slot this tick's
+        # delivery already replaced holds a different rank and survives.
         flat_idx = (level_sel - 1) * K + jnp.maximum(sel_kidx, 0)
-        remove = can & (sel_kidx >= 0)
+        cur_at = new_cand_rank.at[ids, flat_idx].get(
+            mode="fill", fill_value=INT32_MAX
+        )
+        remove = can & (sel_kidx >= 0) & (cur_at == sel_rank)
         safe_row = jnp.where(remove, ids, n)
         new_cand_rank = new_cand_rank.at[safe_row, flat_idx].set(
             INT32_MAX, mode="drop"
@@ -818,10 +849,30 @@ class BatchedHandel(BitsetAggBase):
         # _select reads none of the channel/pos state dissemination
         # writes, and channel slot resolution is order-independent
         # min/max competition).
+        #
+        # _select runs on the BOUNDARY VIEW (r5): the reference's checkSigs
+        # is a conditional task that fires at the ms boundary — after
+        # time++ but BEFORE the new ms's arrivals and before that ms's
+        # updateVerifiedSignatures task (Network.java:533-565) — so the
+        # selection must see candidates and aggregates as of the END of
+        # the previous tick.  Selecting on same-tick state gave the
+        # batched engine a 1-tick information lead per verification hop,
+        # measured as a -4..-9 ms CDF lead (docs/TPU_NOTES.md r5).  The
+        # busy gate stays post-commit (a commit at t frees the node for a
+        # same-tick re-select, like the reference's minStartTime spacing).
+        pre_cand = {k: state.proto[k] for k in self._cand_keys()}
         state = self._channel_deliver(net, state)
+        pre_merge = {
+            k: state.proto[k] for k in ("inc", "ind", "agg", "bl")
+        }
         state = self._commit(net, state)
-        state = self._select(net, state)
+        state = self._select(net, state, view={**pre_cand, **pre_merge})
         return state
+
+    def _cand_keys(self):
+        return ("cand_rank", "cand_rel") + tuple(
+            f"cand_sig{i}" for i in range(len(self.buckets))
+        )
 
     def all_done(self, state):
         live = ~state.down

@@ -215,7 +215,13 @@ class BatchedP2PHandel(BatchedProtocol):
             )
 
         # 2. checkSigs beat: conditional task, min gap pairingTime
-        # (init :505-509), single verification register (see header)
+        # (init :505-509), single verification register (see header).
+        # Known approximation: this reads same-tick state (arrivals of t,
+        # phase-1 commits) where the reference's boundary-fired conditional
+        # task sees end-of-(t-1) — a 1-tick information lead per
+        # verification hop (handel/gsf _select got the boundary-view fix
+        # in r5; here cand is [N, K, N]-dense and double-buffering it
+        # costs more memory than the lead is worth at current parity)
         if p.double_aggregate_strategy:
             # checkSigs2 (:455-479): aggregate everything, verify once
             has_pend = jnp.any(proto["pend"], axis=1)
