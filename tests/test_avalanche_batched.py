@@ -69,6 +69,7 @@ class TestBatchedSlush:
         om, bm = np.median(o), np.median(b)
         assert abs(bm - om) / om <= 0.15, (om, bm)
 
+    @pytest.mark.slow
     def test_flips_with_low_alpha(self):
         """With ak < k (the reference main()'s 4/7 alpha) opposing
         majorities actually flip colors and one color dominates."""

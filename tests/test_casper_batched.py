@@ -7,6 +7,7 @@ deterministic in aggregate, so the oracle comparison can be exact on
 message counts and chain structure."""
 
 import numpy as np
+import pytest
 
 from wittgenstein_tpu.engine import replicate_state
 from wittgenstein_tpu.oracle.blockchain import Block
@@ -28,6 +29,7 @@ def oracle_run(params, run_ms=RUN_MS, seed=0):
 
 
 class TestBatchedCasper:
+    @pytest.mark.slow
     def test_oracle_parity_linear_chain(self):
         """Default honest run: same per-height linear chain, the same
         total message count, heads within one slot of the oracle."""
@@ -74,6 +76,7 @@ class TestBatchedCasper:
         assert h1 >= 3
         assert h2 > h1
 
+    @pytest.mark.slow
     def test_replicas_and_determinism(self):
         net, state = make_casper(CasperParameters(), max_heights=16)
         states = replicate_state(state, 4, seeds=[1, 2, 3, 4])

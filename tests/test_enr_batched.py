@@ -126,6 +126,7 @@ class TestBatchedENR:
         assert recs.max() <= beats + HORIZON // 15_000 + 2
         assert int(out.dropped) == 0
 
+    @pytest.mark.slow
     def test_replicas_and_determinism(self):
         p = small_params()
         net, state = make_enr(p, horizon_ms=20_000, capacity=1024)

@@ -38,6 +38,7 @@ class TestENRGossiping:
             assert n1.y == n2.y
             assert [p.node_id for p in n1.peers] == [p.node_id for p in n2.peers]
 
+    @pytest.mark.slow
     def test_ppt(self, tmp_path):
         """ENRGossipingTest.java:41-75: the ProgressPerTime driver runs."""
         import wittgenstein_tpu.core.stats as SH

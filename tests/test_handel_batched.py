@@ -140,6 +140,7 @@ class TestBatchedHandel:
         assert (b > 0).all()
         assert abs(b.mean() - o.mean()) <= 0.12 * o.mean(), (o.mean(), b.mean())
 
+    @pytest.mark.slow
     def test_attack_slows_aggregation(self):
         """The suicide attack must cost time vs an attack-free run with the
         same number of plainly-dead nodes (wasted verifications+blacklist)."""

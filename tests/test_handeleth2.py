@@ -4,6 +4,8 @@ tree structure, multi-height merge, simple/long runs, dead nodes."""
 
 import random
 
+import pytest
+
 from wittgenstein_tpu.protocols.handeleth2 import (
     PERIOD_AGG_TIME,
     PERIOD_TIME,
@@ -170,6 +172,7 @@ class TestHandelEth2:
         for hl in ap.levels:
             assert hl.is_incoming_complete(), f"n0, {hl}"
 
+    @pytest.mark.slow
     def test_run_with_dead_nodes(self):
         """HandelEth2Test.testRunWithDeadNodes (:164-189)."""
         params = HandelEth2Parameters(

@@ -26,6 +26,7 @@ def make_params(**kw):
 
 
 class TestBatchedHandelEth2:
+    @pytest.mark.slow
     def test_oracle_parity_20s(self):
         """After the first process completes its 18 s window: identical
         aggDone, identical FULL contributions (every process reaches all
@@ -51,6 +52,7 @@ class TestBatchedHandelEth2:
         assert abs(b_msgs - o_msgs) / o_msgs <= 0.20, (o_msgs, b_msgs)
         assert int(out.dropped) == 0
 
+    @pytest.mark.slow
     def test_three_concurrent_processes(self):
         """Steady state holds exactly three live heights, rotating every
         PERIOD_TIME (HandelEth2.java:15-22)."""
@@ -64,6 +66,7 @@ class TestBatchedHandelEth2:
         h2 = np.asarray(out2.proto["height"])
         assert h2.max() == h.max() + 1
 
+    @pytest.mark.slow
     def test_top_level_completes(self):
         """The widest level's incoming reaches its full half-block
         cardinality within the aggregation window."""
@@ -74,6 +77,7 @@ class TestBatchedHandelEth2:
         top = card[:, :, -1].max(axis=1)
         assert (top == net.protocol.n_nodes // 2).all()
 
+    @pytest.mark.slow
     def test_replicas_and_determinism(self):
         net, state = make_handeleth2(make_params())
         states = replicate_state(state, 2, seeds=[1, 2])

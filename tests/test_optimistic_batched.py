@@ -3,6 +3,7 @@ over the same P2P graph gives near-identical done times), done-guard
 semantics, determinism."""
 
 import numpy as np
+import pytest
 
 from wittgenstein_tpu.engine import replicate_state
 from wittgenstein_tpu.protocols.optimistic_p2p_signature import (
@@ -41,6 +42,7 @@ class TestBatchedOptimistic:
         bmsgs = int(np.asarray(out.msg_received).sum())
         assert abs(bmsgs - omsgs) / omsgs <= 0.03, (omsgs, bmsgs)
 
+    @pytest.mark.slow
     def test_done_at_offset(self):
         """doneAt = crossing time + 2*pairingTime
         (OptimisticP2PSignature.java:131): raising pairing_time shifts every
@@ -58,6 +60,7 @@ class TestBatchedOptimistic:
         counts = np.asarray(out.proto["received"]).sum(axis=1)
         assert (counts >= net.protocol.params.threshold).all()
 
+    @pytest.mark.slow
     def test_replicas_and_determinism(self):
         net, state = make_optimistic(make_params())
         states = replicate_state(state, 4, seeds=[7, 8, 9, 10])

@@ -34,6 +34,7 @@ def oracle_done(params, seeds, run_ms=8000):
 
 
 class TestBatchedP2PHandel:
+    @pytest.mark.slow
     def test_oracle_parity(self):
         """P50/P90 of doneAt within 10% of the oracle DES."""
         p = make_params()
@@ -61,6 +62,7 @@ class TestBatchedP2PHandel:
         out = net.run_ms(state, 8000)
         assert (np.asarray(out.done_at) > 0).all()
 
+    @pytest.mark.slow
     def test_all_strategy_matches_dif_counts(self):
         """'all' ships the full set instead of the diff; convergence is the
         same (only wire sizes differ in the reference)."""
@@ -73,6 +75,7 @@ class TestBatchedP2PHandel:
         assert (d1 > 0).all() and (d2 > 0).all()
         assert abs(np.median(d1) - np.median(d2)) / np.median(d1) <= 0.1
 
+    @pytest.mark.slow
     def test_check_sigs1_oracle_parity(self):
         """The single-best verification strategy (checkSigs1,
         P2PHandel.java:419-447): P50/P90 of doneAt within 12% of the
@@ -90,6 +93,7 @@ class TestBatchedP2PHandel:
         rel = np.abs(bq - oq) / oq
         assert (rel <= 0.12).all(), (oq, bq, rel)
 
+    @pytest.mark.slow
     def test_send_state_broadcasts(self):
         """State broadcasts (send_state=True): receivers learn peer states
         without extra to_verify work; still converges, and traffic grows
@@ -109,6 +113,7 @@ class TestBatchedP2PHandel:
         bd = np.asarray(o1.done_at)
         assert abs(np.median(bd) - np.median(od)) / np.median(od) <= 0.15
 
+    @pytest.mark.slow
     def test_determinism(self):
         net, state = make_p2phandel(make_params())
         states = replicate_state(state, 4, seeds=[3, 4, 5, 6])

@@ -7,6 +7,7 @@ picks, SanFerminSignature.java:334-338), so parity is measured on the done
 population and the done fraction, not on all nodes."""
 
 import numpy as np
+import pytest
 
 from wittgenstein_tpu.engine import replicate_state
 from wittgenstein_tpu.protocols.sanfermin import (
@@ -55,6 +56,7 @@ class TestBatchedSanFermin:
         assert (agg[done > 0] >= 64).all()
         assert int(out.dropped.max()) == 0
 
+    @pytest.mark.slow
     def test_oracle_parity(self):
         """Done fraction within 7 points and P50/P90 of doneAt (among done
         nodes) within 15% of the oracle DES."""
@@ -87,6 +89,7 @@ class TestBatchedSanFermin:
         assert (thr[fin] > 0).all()
         assert (thr[fin] <= done[fin]).all()
 
+    @pytest.mark.slow
     def test_replicas_and_determinism(self):
         net, state = make_sanfermin(make_params(node_count=32, threshold=32))
         states = replicate_state(state, 4, seeds=[11, 12, 13, 14])

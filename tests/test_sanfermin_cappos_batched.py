@@ -2,6 +2,7 @@
 oracle parity, determinism."""
 
 import numpy as np
+import pytest
 
 from wittgenstein_tpu.engine import replicate_state
 from wittgenstein_tpu.protocols.sanfermin_cappos import (
@@ -37,6 +38,7 @@ def oracle_stats(params, seeds, run_ms=5000):
 
 
 class TestBatchedSanFerminCappos:
+    @pytest.mark.slow
     def test_oracle_parity(self):
         """Done fraction within 5 points; P50 within 15% and P90 within
         20% of the oracle DES.  The batched engine runs the San Fermin
@@ -82,6 +84,7 @@ class TestBatchedSanFerminCappos:
         done = np.asarray(out.done_at) > 0
         assert cache[done].any(axis=1).all()
 
+    @pytest.mark.slow
     def test_determinism(self):
         net, state = make_sanfermin_cappos(make_params())
         states = replicate_state(state, 4, seeds=[9, 10, 11, 12])

@@ -2,6 +2,7 @@
 rebuilt as stacked vmap sweeps with CSV output)."""
 
 import numpy as np
+import pytest
 
 from wittgenstein_tpu.scenarios.handel_scenarios import (
     CSV_FIELDS,
@@ -38,6 +39,7 @@ class TestSweepRunner:
         assert all(bs.done_at_min > 0 for bs in stats)
         assert stats[1].done_at_avg > stats[0].done_at_avg
 
+    @pytest.mark.slow
     def test_scenario_csv(self, tmp_path):
         out = tmp_path / "byz.csv"
         stats = run_scenario(
