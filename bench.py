@@ -413,11 +413,12 @@ def _headline(
             "handel-full: windowed scoring, Byzantine attack machinery,"
             " fastPath, per-node pairing.  r4: send-time xor_shuffle,"
             " due-pair delivery, beat-gated dissemination, 20-tick"
-            " readback-synced chunks, and the DES-quiescence early"
-            " exit (stop_when_done) — ticks after every replica"
-            " aggregates are skipped, like the oracle's empty event"
-            " queue; done_at parity pinned by test.  Not comparable"
-            " to the r1/r2 lite engine"
+            " readback-synced chunks, DES-quiescence early exit"
+            " (stop_when_done).  r5: CHANNEL_DEPTH=32 (displacement"
+            " 25%->10%), boundary-view selection (reference conditional-"
+            "task timing; CDF parity ~1% at P10/P50), absolute-arrival"
+            " channel keys (no per-tick countdown traffic), PRP reception"
+            " ranks.  Not comparable to the r1/r2 lite engine"
         ),
         "probe": probe,
         "bench_error": bench_error,

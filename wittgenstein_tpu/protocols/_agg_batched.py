@@ -633,6 +633,34 @@ class BitsetAggBase(BatchedProtocol):
         updates["displaced"] = proto["displaced"] + res[-1] + time_overflow
         return state._replace(proto=updates)
 
+    # -- entry-identity candidate clears (shared by the _select
+    # write-backs of handel_batched and gsf_batched: see the
+    # handel_batched._select docstring for the semantics) ------------------
+    @staticmethod
+    def _entry_clear(cur_id3, cur_card3, tgt_id3, tgt_card3, tgt_mask3):
+        """[N, L-1, K] clear mask: current entries equal in (id,
+        cardinality) to any masked target entry of the same level."""
+        m = (
+            (cur_id3[..., :, None] == tgt_id3[..., None, :])
+            & (cur_card3[..., :, None] == tgt_card3[..., None, :])
+            & tgt_mask3[..., None, :]
+        )
+        return jnp.any(m, axis=-1)
+
+    @staticmethod
+    def _remove_chosen(ids, id3, card3, lvl_idx, sel_id, sel_card, remove):
+        """Clear the chosen entry from its level's CURRENT slots by (id,
+        cardinality) identity; returns the updated [N, L-1, K] id array
+        (non-removing rows write their row back unchanged)."""
+        row_id = jnp.take_along_axis(id3, lvl_idx[:, None, None], axis=1)[:, 0]
+        row_card = jnp.take_along_axis(card3, lvl_idx[:, None, None], axis=1)[:, 0]
+        mrow = (
+            remove[:, None]
+            & (row_id == sel_id[:, None])
+            & (row_card == sel_card[:, None])
+        )
+        return id3.at[ids, lvl_idx].set(jnp.where(mrow, INT32_MAX, row_id))
+
     def _size_table(self):
         return np.asarray(
             [self.msg_size(t) for t in range(self.n_levels)], np.int32

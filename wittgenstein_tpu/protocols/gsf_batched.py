@@ -495,10 +495,9 @@ class BatchedGSF(BitsetAggBase):
         conditional task (GSFSignature.java:631-632, Network.java:533-565;
         same mechanism as handel_batched._select).  Write-backs are
         compare-and-clear (on the sender-rel key) / bit-clear merges.
-        Known imprecision, bounded by the periodic re-offers: the rel key
-        identifies the SENDER, not the entry, so a same-sender refresh
-        delivered this tick into a condemned/chosen slot index clears
-        with its predecessor (see the equivalent handel_batched note)."""
+        Write-backs target the viewed entry by (key, cardinality)
+        identity matched against any current slot of the level — see the
+        equivalent handel_batched._select note."""
         proto = state.proto
         v = proto if view is None else {**proto, **view}
         t = state.time
@@ -509,7 +508,7 @@ class BatchedGSF(BitsetAggBase):
         ver, indiv, pend = v["ver"], v["indiv"], v["pend_ind"]
 
         score_p, rel_p, pk_p, kidx_p = [], [], [], []
-        key_pieces, pend_pieces = [], []
+        key_pieces, pend_pieces, vcard_pieces, ccard_pieces = [], [], [], []
         for i, b in enumerate(self.buckets):
             sl = slice(b.lo - 1, b.hi)
             lv = jnp.asarray(b.levels, jnp.int32)
@@ -529,8 +528,11 @@ class BatchedGSF(BitsetAggBase):
             )
             score = jnp.where(valid, score, -1)
             # curation: drop worthless entries permanently (condemn mask,
-            # applied compare-and-clear below)
+            # applied by entry identity below)
             key_pieces.append(valid & (score == 0))
+            vcard_pieces.append(popcount_words(c_sig))
+            cur_sig = self._sig_view(proto, i, K, prefix="cand_sig")
+            ccard_pieces.append(popcount_words(cur_sig))
             kbest = jnp.argmax(score, axis=2)
             sbest = jnp.take_along_axis(score, kbest[..., None], axis=2)[..., 0]
 
@@ -577,12 +579,17 @@ class BatchedGSF(BitsetAggBase):
         pend_after_view = self._assemble(pend, pend_pieces)
         pend_clear = v["pend_ind"] & ~pend_after_view
         pend = proto["pend_ind"] & ~pend_clear
-        # curation removal, compare-and-clear against the viewed key
-        condemn = jnp.concatenate(key_pieces, axis=1).reshape(n, (L - 1) * K)
-        cur_key = proto["cand_key"]
-        new_cand_key = jnp.where(
-            condemn & (cur_key == v["cand_key"]), INT32_MAX, cur_key
-        )
+        # curation removal by (key, cardinality) ENTRY IDENTITY matched
+        # against any current slot of the level (the key alone is only the
+        # sender rel; a same-sender refresh differs in cardinality — see
+        # the handel_batched note)
+        condemn3 = jnp.concatenate(key_pieces, axis=1)  # [N, L-1, K]
+        vkey3 = v["cand_key"].reshape(n, L - 1, K)
+        vcard3 = jnp.concatenate(vcard_pieces, axis=1)
+        ckey3 = proto["cand_key"].reshape(n, L - 1, K)
+        ccard3 = jnp.concatenate(ccard_pieces, axis=1)
+        cleared = self._entry_clear(ckey3, ccard3, vkey3, vcard3, condemn3)
+        new_key3 = jnp.where(cleared, INT32_MAX, ckey3)
 
         # global best across levels; ascending-level iteration with strict >
         # in the original = first maximum wins = argmax
@@ -618,17 +625,19 @@ class BatchedGSF(BitsetAggBase):
         oh_full = self._onehot(best_rel, self.n_words)
         pend = jnp.where((can & sel_single)[:, None], pend & ~oh_full, pend)
 
-        # remove the chosen buffer candidate — compare-and-clear against
-        # the VIEWED key (best_rel is the chosen candidate's c_key value)
-        flat_idx = (best_level - 1) * K + jnp.maximum(best_kidx, 0)
-        cur_at = new_cand_key.at[ids, flat_idx].get(
-            mode="fill", fill_value=INT32_MAX
+        # remove the chosen buffer candidate by (key, cardinality) entry
+        # identity against the chosen level's CURRENT slots
+        lvl_idx = jnp.maximum(best_level - 1, 0)
+        sel_card = jnp.take_along_axis(
+            jnp.take_along_axis(vcard3, lvl_idx[:, None, None], axis=1)[:, 0],
+            jnp.maximum(best_kidx, 0)[:, None],
+            axis=1,
+        )[:, 0]
+        remove = can & ~sel_single
+        new_key3 = self._remove_chosen(
+            ids, new_key3, ccard3, lvl_idx, best_rel, sel_card, remove
         )
-        remove = can & ~sel_single & (cur_at == best_rel)
-        safe_row = jnp.where(remove, ids, n)
-        new_cand_key = new_cand_key.at[safe_row, flat_idx].set(
-            INT32_MAX, mode="drop"
-        )
+        new_cand_key = new_key3.reshape(n, (L - 1) * K)
 
         state = state._replace(
             proto=dict(

@@ -557,15 +557,14 @@ class BatchedHandel(BitsetAggBase):
         `view` (tick() passes it) holds the BOUNDARY state — candidates
         and aggregates as of the end of the previous tick — which is what
         the reference's boundary-fired checkSigs sees.  Candidate
-        write-backs (curation removal, chosen-slot consumption) are
-        compare-and-clear against the viewed rank: a slot this tick's
-        delivery repopulated with a DIFFERENT-rank candidate survives.
-        Known imprecision, bounded by the periodic re-offers: delivery
-        re-sorts the K slots on arrival ticks, so a same-rank refresh
-        landing in a condemned/chosen slot index can be cleared with its
-        predecessor, and a moved chosen entry can survive for one
-        duplicate verification — a contributor to the documented P90
-        slow tail (see test_oracle_quantile_parity)."""
+        write-backs (curation removal, chosen-slot consumption) target
+        the viewed ENTRY by (rank, cardinality) identity matched against
+        any current slot of the level: delivery re-sorts the K slots on
+        arrival ticks, so slot-index matching would both miss moved
+        entries and clobber same-rank refreshes.  Rank is unique per
+        (receiver, level, sender) and a refreshed aggregate differs in
+        cardinality, so the only ambiguity is content-equal duplicates —
+        clearing those loses nothing."""
         p = self.params
         proto = state.proto
         v = proto if view is None else {**proto, **view}
@@ -589,7 +588,7 @@ class BatchedHandel(BitsetAggBase):
         # per-level bests, one stacked body per bucket
         has_p, b_rank_p, b_rel_p, b_bad_p, b_kidx_p = [], [], [], [], []
         widx_p, insc_p = [], []
-        condemn_pieces = []
+        condemn_pieces, vcard_pieces, ccard_pieces = [], [], []
         for i, b in enumerate(self.buckets):
             sl = slice(b.lo - 1, b.hi)
             lv = jnp.asarray(b.levels, jnp.int32)
@@ -611,8 +610,10 @@ class BatchedHandel(BitsetAggBase):
             bl_bit = self._getbit(bl, c_rel)
             curated = valid & (s > popcount_words(inc_b)[:, :, None]) & (bl_bit == 0)
             # permanent removal, like replaceToVerifyAgg (:612-618) —
-            # recorded as a condemn mask, applied compare-and-clear below
+            # recorded as a condemn mask, applied by ENTRY IDENTITY below
             condemn_pieces.append(valid & ~curated)
+            cur_sig = self._sig_view(proto, i, K, prefix="cand_sig")
+            ccard_pieces.append(popcount_words(cur_sig))
 
             # windowIndex = min rank over the (pre-curation valid) queue
             window_index = jnp.min(
@@ -628,6 +629,7 @@ class BatchedHandel(BitsetAggBase):
             # score (:650-664)
             agg_card = popcount_words(agg_b)  # [N, nl]
             sig_card = popcount_words(c_sig)
+            vcard_pieces.append(sig_card)
             agg_inter = popcount_words(c_sig & agg_b[:, :, None, :]) > 0
             with_ind = popcount_words(c_sig | ind_b[:, :, None, :])
             score = jnp.where(
@@ -693,14 +695,22 @@ class BatchedHandel(BitsetAggBase):
         b_rel = self._level_stats(b_rel_p)
         b_bad = self._level_stats(b_bad_p)
         b_kidx = self._level_stats(b_kidx_p)
-        # curation removal, compare-and-clear: only clear a slot that still
-        # holds the rank the view condemned (this tick's delivery may have
-        # repopulated it with a fresh candidate)
-        condemn = jnp.concatenate(condemn_pieces, axis=1).reshape(n, (L - 1) * K)
-        cur_rank = proto["cand_rank"]
-        new_cand_rank = jnp.where(
-            condemn & (cur_rank == v["cand_rank"]), INT32_MAX, cur_rank
-        )
+        # curation removal by ENTRY IDENTITY (rank, cardinality) matched
+        # against ANY current slot of the level: delivery re-sorts the K
+        # slots on arrival ticks, so slot-index matching would miss moved
+        # entries (surviving for a duplicate verification) and clobber
+        # same-rank refreshes; rank is unique per (receiver, level,
+        # sender) and a refreshed aggregate has a different cardinality,
+        # so the pair identifies the viewed entry up to content-equal
+        # duplicates (clearing those loses nothing)
+        condemn3 = jnp.concatenate(condemn_pieces, axis=1)  # [N, L-1, K]
+        vrank3 = v["cand_rank"].reshape(n, L - 1, K)
+        vcard3 = jnp.concatenate(vcard_pieces, axis=1)
+        crank3 = proto["cand_rank"].reshape(n, L - 1, K)
+        ccard3 = jnp.concatenate(ccard_pieces, axis=1)
+
+        cleared = self._entry_clear(crank3, ccard3, vrank3, vcard3, condemn3)
+        new_rank3 = jnp.where(cleared, INT32_MAX, crank3)
 
         # chooseBestFromLevels: uniform among levels with a candidate (:788)
         vcount = jnp.sum(has, axis=1).astype(jnp.int32)
@@ -810,18 +820,20 @@ class BatchedHandel(BitsetAggBase):
             ver_sig = jnp.where(m[:, None], sig_l, ver_sig)
 
         # remove the chosen buffer candidate (commit-time removal in the
-        # reference; removal at selection avoids double-verification).
-        # Compare-and-clear against the VIEWED rank: a slot this tick's
-        # delivery already replaced holds a different rank and survives.
-        flat_idx = (level_sel - 1) * K + jnp.maximum(sel_kidx, 0)
-        cur_at = new_cand_rank.at[ids, flat_idx].get(
-            mode="fill", fill_value=INT32_MAX
+        # reference; removal at selection avoids double-verification) —
+        # matched by (rank, cardinality) entry identity against the
+        # chosen level's CURRENT slots, like the curation clear above
+        lvl_idx = jnp.maximum(level_sel - 1, 0)
+        sel_card = jnp.take_along_axis(
+            jnp.take_along_axis(vcard3, lvl_idx[:, None, None], axis=1)[:, 0],
+            jnp.maximum(sel_kidx, 0)[:, None],
+            axis=1,
+        )[:, 0]
+        remove = can & (sel_kidx >= 0)
+        new_rank3 = self._remove_chosen(
+            ids, new_rank3, ccard3, lvl_idx, sel_rank, sel_card, remove
         )
-        remove = can & (sel_kidx >= 0) & (cur_at == sel_rank)
-        safe_row = jnp.where(remove, ids, n)
-        new_cand_rank = new_cand_rank.at[safe_row, flat_idx].set(
-            INT32_MAX, mode="drop"
-        )
+        new_cand_rank = new_rank3.reshape(n, (L - 1) * K)
 
         state = state._replace(
             proto=dict(
