@@ -92,7 +92,8 @@ class TestBatchedHandel:
     @pytest.mark.slow
     def test_oracle_quantile_parity(self):
         """P10/P50/P90 of time-to-threshold vs the oracle DES, per-quantile
-        bounds (2%, 3%, 5.5%) — measured (+0.4%, +1.5%, +4.3%).
+        bounds (2%, 3%, 5.5%) — measured (-0.4%, +1.2%, +4.1%) after the
+        entry-identity write-back fix.
 
         Residual attribution (r5, scripts/parity_residual.py + ablations
         at 48 oracle runs x 96 replicas, sampling noise < 0.4%), in the
