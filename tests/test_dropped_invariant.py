@@ -1,0 +1,182 @@
+"""Cross-protocol invariant: `state.dropped == 0` after every batched
+protocol's standard scenario run.
+
+`dropped` counts messages the store could not hold (wheel row + overflow
+lane full, or flat ring full).  A nonzero value means the simulation
+silently lost traffic — results are garbage, but nothing else fails
+loudly.  Every protocol's own tests assert it incidentally; this file is
+the single place that pins the invariant for ALL of them, so a future
+resizing of the wheel/overflow defaults cannot quietly regress one
+protocol's scenario.
+
+Configs mirror each protocol's standard-scenario test (same shapes →
+persistent-compile-cache hits keep this file cheap)."""
+
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.protocols.avalanche_batched import make_slush, make_snowflake
+from wittgenstein_tpu.protocols.casper import CasperParameters
+from wittgenstein_tpu.protocols.casper_batched import make_casper
+from wittgenstein_tpu.protocols.dfinity import DfinityParameters
+from wittgenstein_tpu.protocols.dfinity_batched import make_dfinity
+from wittgenstein_tpu.protocols.enr_gossiping import ENRParameters
+from wittgenstein_tpu.protocols.enr_batched import make_enr
+from wittgenstein_tpu.protocols.gsf import GSFSignatureParameters
+from wittgenstein_tpu.protocols.gsf_batched import make_gsf
+from wittgenstein_tpu.protocols.handel import HandelParameters
+from wittgenstein_tpu.protocols.handel_batched import make_handel
+from wittgenstein_tpu.protocols.handeleth2 import HandelEth2Parameters
+from wittgenstein_tpu.protocols.handeleth2_batched import make_handeleth2
+from wittgenstein_tpu.protocols.optimistic_p2p_signature import (
+    OptimisticP2PSignatureParameters,
+)
+from wittgenstein_tpu.protocols.optimistic_p2p_signature_batched import (
+    make_optimistic,
+)
+from wittgenstein_tpu.protocols.p2pflood import P2PFloodParameters
+from wittgenstein_tpu.protocols.p2pflood_batched import make_p2pflood
+from wittgenstein_tpu.protocols.p2phandel import P2PHandelParameters
+from wittgenstein_tpu.protocols.p2phandel_batched import make_p2phandel
+from wittgenstein_tpu.protocols.paxos import PaxosParameters
+from wittgenstein_tpu.protocols.paxos_batched import make_paxos
+from wittgenstein_tpu.protocols.pingpong_batched import make_pingpong
+from wittgenstein_tpu.protocols.sanfermin import SanFerminSignatureParameters
+from wittgenstein_tpu.protocols.sanfermin_batched import make_sanfermin
+from wittgenstein_tpu.protocols.sanfermin_cappos import SanFerminParameters
+from wittgenstein_tpu.protocols.sanfermin_cappos_batched import (
+    make_sanfermin_cappos,
+)
+
+
+def _handel_params():
+    return HandelParameters(
+        node_count=64,
+        threshold=int(64 * 0.99),
+        pairing_time=3,
+        level_wait_time=50,
+        extra_cycle=10,
+        dissemination_period_ms=10,
+        fast_path=10,
+        nodes_down=0,
+    )
+
+
+def _gsf_params():
+    return GSFSignatureParameters(
+        node_count=64,
+        threshold=int(64 * 0.99),
+        pairing_time=3,
+        timeout_per_level_ms=50,
+        period_duration_ms=10,
+        accelerated_calls_count=10,
+        nodes_down=0,
+    )
+
+
+def _sanfermin_params():
+    return SanFerminSignatureParameters(
+        node_count=64,
+        threshold=64,
+        pairing_time=2,
+        signature_size=48,
+        reply_timeout=300,
+        candidate_count=1,
+        shuffled_lists=False,
+    )
+
+
+def _cappos_params():
+    return SanFerminParameters(
+        node_count=64,
+        threshold=32,
+        pairing_time=2,
+        signature_size=48,
+        timeout=150,
+        candidate_count=4,
+    )
+
+
+def _enr_params():
+    return ENRParameters(
+        nodes=24,
+        total_peers=4,
+        max_peers=10,
+        number_of_different_capabilities=5,
+        cap_per_node=2,
+        cap_gossip_time=5_000,
+        time_to_leave=50_000,
+        time_to_change=10_000_000,
+        changing_nodes=1,
+        discard_time=100,
+    )
+
+
+# (id, factory, run_ms) — factories return (net, state).  The fast set
+# keeps the tier-1 budget gate honest (store pressure is front-loaded in
+# these scenarios, so shortened horizons still see the peak); the heavier
+# protocols run the full standard horizons in the slow tier.
+CASES = [
+    ("pingpong", lambda: make_pingpong(256), 900),
+    ("p2pflood", lambda: make_p2pflood(P2PFloodParameters(), capacity=2048), 2001),
+    ("paxos", lambda: make_paxos(PaxosParameters()), 5000),
+    ("slush", lambda: make_slush(), 2000),
+    ("snowflake", lambda: make_snowflake(), 2000),
+    ("handel", lambda: make_handel(_handel_params()), 1500),
+    ("gsf", lambda: make_gsf(_gsf_params()), 1000),
+]
+
+SLOW_CASES = [
+    (
+        "optimistic",
+        lambda: make_optimistic(
+            OptimisticP2PSignatureParameters(
+                node_count=64, threshold=56, connection_count=10, pairing_time=3
+            )
+        ),
+        1500,
+    ),
+    ("p2phandel", lambda: make_p2phandel(P2PHandelParameters()), 3000),
+    ("sanfermin", lambda: make_sanfermin(_sanfermin_params()), 6000),
+    ("sanfermin_cappos", lambda: make_sanfermin_cappos(_cappos_params()), 5000),
+    (
+        "handeleth2",
+        lambda: make_handeleth2(
+            HandelEth2Parameters(
+                node_count=32,
+                pairing_time=3,
+                level_wait_time=100,
+                period_duration_ms=50,
+                nodes_down=0,
+            )
+        ),
+        12000,
+    ),
+    ("dfinity", lambda: make_dfinity(DfinityParameters(), max_heights=64), 15000),
+    ("casper", lambda: make_casper(CasperParameters(), max_heights=16), 80000),
+    ("enr", lambda: make_enr(_enr_params(), horizon_ms=30_000, capacity=1024), 30_000),
+]
+
+
+def _assert_no_drops(name, build, run_ms):
+    net, state = build()
+    out = net.run_ms(state, run_ms)
+    dropped = int(np.asarray(out.dropped).max())
+    assert dropped == 0, (
+        f"{name}: {dropped} messages dropped (store overflow) — "
+        f"wheel_rows={net.wheel_rows} wheel_slots={net.wheel_slots} "
+        f"overflow_capacity={net.overflow_capacity} flat={net.flat}"
+    )
+
+
+@pytest.mark.parametrize("name,build,run_ms", CASES, ids=[c[0] for c in CASES])
+def test_no_messages_dropped(name, build, run_ms):
+    _assert_no_drops(name, build, run_ms)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "name,build,run_ms", SLOW_CASES, ids=[c[0] for c in SLOW_CASES]
+)
+def test_no_messages_dropped_slow(name, build, run_ms):
+    _assert_no_drops(name, build, run_ms)

@@ -1,0 +1,46 @@
+"""replica_shard's compiled-program cache: explicit keys, bounded size,
+and the clear hook (the lru_cache(maxsize=64)-keyed-on-the-net fix)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from wittgenstein_tpu.engine import replicate_state
+from wittgenstein_tpu.parallel import clear_run_cache, run_cache_info
+from wittgenstein_tpu.parallel.replica_shard import _run_and_reduce, sharded_run_stats
+from wittgenstein_tpu.protocols.pingpong_batched import make_pingpong
+
+
+class TestRunCache:
+    def test_same_net_hits_distinct_net_misses(self):
+        clear_run_cache()
+        net_a, s_a = make_pingpong(40, seed=1)
+        net_b, _ = make_pingpong(40, seed=1)
+        fn1 = _run_and_reduce(net_a, 200)
+        fn2 = _run_and_reduce(net_a, 200)
+        assert fn1 is fn2  # same key -> same compiled program
+        assert run_cache_info()["size"] == 1
+        # a different engine instance carries different (protocol, latency)
+        # object identities -> its own entry, never a wrong-program replay
+        fn3 = _run_and_reduce(net_b, 200)
+        assert fn3 is not fn1
+        # a different horizon is a different program
+        fn4 = _run_and_reduce(net_a, 300)
+        assert fn4 is not fn1
+        assert run_cache_info()["size"] == 3
+
+        out, stats = fn1(replicate_state(s_a, 2))
+        assert int(np.asarray(out.time).max()) == 200
+        assert "done_min" in stats
+
+        clear_run_cache()
+        assert run_cache_info()["size"] == 0
+
+    def test_sharded_run_stats_still_works(self):
+        clear_run_cache()
+        net, state = make_pingpong(30, seed=2)
+        states = replicate_state(state, 2)
+        out, stats = sharded_run_stats(net, states, 150)
+        assert out.proto["pong"].shape[0] == 2
+        assert bool(jnp.isfinite(stats["msg_rcv_avg"]))
+        assert run_cache_info()["size"] == 1
+        clear_run_cache()

@@ -36,10 +36,19 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
+# message-store layout generation stamped into every checkpoint: the
+# time-wheel rewrite changed SimState's ring fields ([C] flat ring ->
+# [W, B] wheel + [V] overflow lane), so a checkpoint from the flat-ring
+# era can never resume on this engine — fail with the reason, not with a
+# leaf-by-leaf shape mismatch
+LAYOUT_KEY = "__engine_layout__"
+ENGINE_LAYOUT = "timewheel-v1"
+
+
 def save_state(state: Any, dest: str) -> None:
     """Write a state pytree to `dest` (.npz), keyed by tree path."""
     leaves = jax.tree_util.tree_flatten_with_path(state)[0]
-    arrays = {}
+    arrays = {LAYOUT_KEY: np.asarray(ENGINE_LAYOUT)}
     for path, leaf in leaves:
         arrays[_path_str(path)] = np.asarray(leaf)
     # stream straight to a temp file (savez appends .npz when missing),
@@ -53,6 +62,14 @@ def load_state(template: Any, src: str) -> Any:
     """Rebuild a state pytree with `template`'s structure from `src`.
     Shapes and dtypes must match the template's leaves."""
     with np.load(src) as data:
+        if LAYOUT_KEY in data:
+            found = str(data[LAYOUT_KEY])
+            if found != ENGINE_LAYOUT:
+                raise ValueError(
+                    f"checkpoint {src} was written by engine layout "
+                    f"{found!r}; this engine is {ENGINE_LAYOUT!r} — re-run "
+                    "the simulation instead of resuming"
+                )
         leaves_t, treedef = jax.tree_util.tree_flatten_with_path(template)
         leaves = []
         for path, leaf in leaves_t:

@@ -5,9 +5,13 @@ per-millisecond state transition suitable for TPUs:
 
   * node state is a struct-of-arrays pytree of `[N]` columns
     (Node.java:22-88 fields become columns);
-  * in-flight messages live in a fixed-capacity ring `[C]` of
-    (arrival, from, to, type, payload) with a validity mask — the
-    static-shape analog of MessageStorage (Network.java:116-299);
+  * in-flight messages live in a TIME WHEEL — `[W, B]` buckets keyed by
+    `arrival mod W` plus a small `[V]` overflow lane for beyond-horizon
+    arrivals — the calendar-queue analog of MessageStorage
+    (Network.java:116-299, which exists precisely so the reference never
+    scans an unsorted event list).  A tick's delivery reads only its own
+    bucket row(s) and the overflow lane: O(B + V) per tick instead of
+    O(C) over a flat ring (see docs/engine_timewheel.md);
   * per-destination latency jitter comes from the reference's own xorshift
     counter hash (rng.pseudo_delta), so multicast costs no per-dest state,
     exactly like MultipleDestEnvelope (Envelope.java:46-56);
@@ -26,6 +30,12 @@ Semantics deltas vs the oracle (documented, by design — SURVEY §7):
     boundary tick in the earlier call);
   * randomness is counter-based, so message *distributions* match the
     oracle but individual draws differ.
+
+Protocols see the wheel only through the delivery VIEW: `deliver` still
+receives `state.msg_*` columns aligned with `deliver_mask` — the engine
+gathers the due bucket rows + the overflow lane into flat `[D]` arrays
+before the call and restores the wheel storage afterwards, so protocol
+delivery kernels are layout-agnostic.
 """
 
 from __future__ import annotations
@@ -40,10 +50,17 @@ import numpy as np
 from jax import lax
 
 from ..core.latency import LatencyStatic, NetworkLatency, vec_latency
+from ..ops.bitops import lowest_set_bit, pack_bool_words, popcount_words
 from .rng import hash32, pseudo_delta
 
 MAX_PARTITIONS = 4
 INT_MAX = np.int32(2**31 - 1)
+
+# default wheel horizon, ms: covers the WAN latency models' bulk; rarer
+# longer delays (heavy jitter tails, Mathis throughput delays, protocol
+# timeouts) spill to the overflow lane, which stays exact — the wheel is
+# a fast path, never a correctness boundary
+DEFAULT_WHEEL_ROWS = 512
 
 
 class SimState(NamedTuple):
@@ -68,15 +85,26 @@ class SimState(NamedTuple):
     city_idx: jnp.ndarray  # int32[N]
     # partitions (Network.java:639-707)
     partition_x: jnp.ndarray  # int32[MAX_PARTITIONS], INT_MAX = unused
-    # message ring
-    msg_valid: jnp.ndarray  # bool[C]
-    msg_arrival: jnp.ndarray  # int32[C]
-    msg_from: jnp.ndarray  # int32[C]
-    msg_to: jnp.ndarray  # int32[C]
-    msg_type: jnp.ndarray  # int32[C]
-    msg_payload: jnp.ndarray  # int32[C, P]
-    msg_head: jnp.ndarray  # int32 scalar: next write cursor
-    dropped: jnp.ndarray  # int32 scalar: ring-overflow count (must stay 0)
+    # time wheel [W, B]: row r holds messages with eff-arrival ≡ r (mod W).
+    # The msg_* names are shared with the delivery view handed to
+    # protocol.deliver (flat [D] gathers of the due rows + overflow).
+    msg_valid: jnp.ndarray  # bool[W, B]
+    msg_arrival: jnp.ndarray  # int32[W, B]
+    msg_from: jnp.ndarray  # int32[W, B]
+    msg_to: jnp.ndarray  # int32[W, B]
+    msg_type: jnp.ndarray  # int32[W, B]
+    msg_payload: jnp.ndarray  # int32[W, B, P]
+    whl_fill: jnp.ndarray  # int32[W]: valid entries per row (dense prefix)
+    # overflow lane [V]: beyond-horizon arrivals + full-row spill; scanned
+    # (arrival <= t) every tick like the old flat ring, but V << W*B
+    ovf_valid: jnp.ndarray  # bool[V]
+    ovf_arrival: jnp.ndarray  # int32[V]
+    ovf_from: jnp.ndarray  # int32[V]
+    ovf_to: jnp.ndarray  # int32[V]
+    ovf_type: jnp.ndarray  # int32[V]
+    ovf_payload: jnp.ndarray  # int32[V, P]
+    msg_head: jnp.ndarray  # int32 scalar: monotone sent-message counter
+    dropped: jnp.ndarray  # int32 scalar: wheel+overflow overflow count
     proto: Any  # protocol-defined pytree
 
 
@@ -104,7 +132,17 @@ class Emission:
 class BatchedNetwork:
     """The engine: binds a latency model + protocol to compiled step/run
     functions.  One instance is reusable across replica counts (everything
-    batched lives in SimState)."""
+    batched lives in SimState).
+
+    Message storage is a time wheel `[wheel_rows, wheel_slots]` plus an
+    `[overflow_capacity]` lane (see module docstring).  `wheel_rows=0`
+    selects FLAT mode: everything goes through the overflow lane, which
+    reproduces the old full-scan ring exactly — used by protocols whose
+    scheduling is dominated by far-future explicit arrivals (Casper's 8 s
+    slots, ENR's wake calendar) and by the agg protocols whose messaging
+    bypasses the generic ring entirely.  `capacity` keeps its historical
+    meaning (total in-flight budget) and sizes the wheel/overflow defaults.
+    """
 
     def __init__(
         self,
@@ -114,6 +152,9 @@ class BatchedNetwork:
         capacity: int = 1 << 14,
         msg_discard_time: int = int(INT_MAX),
         throughput=None,  # optional core.throughput.MathisNetworkThroughput
+        wheel_rows: Optional[int] = None,
+        wheel_slots: Optional[int] = None,
+        overflow_capacity: Optional[int] = None,
     ):
         self.protocol = protocol
         self.latency = latency
@@ -125,13 +166,47 @@ class BatchedNetwork:
         sizes = [protocol.msg_size(t) for t in range(protocol.n_msg_types())]
         self._msg_sizes = np.asarray(sizes, dtype=np.int32)
 
+        if wheel_rows is None:
+            wheel_rows = DEFAULT_WHEEL_ROWS
+        self.flat = wheel_rows == 0
+        if self.flat:
+            # degenerate 1x1 wheel keeps the pytree shape uniform; inserts
+            # never target it, so per-tick cost is the overflow scan = the
+            # old flat-ring behavior, bit for bit
+            self.wheel_rows = 1
+            self.wheel_slots = 1
+            self.overflow_capacity = (
+                capacity if overflow_capacity is None else overflow_capacity
+            )
+        else:
+            if wheel_rows % 32:
+                raise ValueError(
+                    f"wheel_rows={wheel_rows} must be a multiple of 32 "
+                    "(occupancy is scanned as packed uint32 words)"
+                )
+            self.wheel_rows = wheel_rows
+            self.wheel_slots = (
+                max(64, -(-2 * capacity // wheel_rows))
+                if wheel_slots is None
+                else wheel_slots
+            )
+            # capped: the lane serves far-future arrivals + full-row spill,
+            # and it is scanned every tick — per-tick delivery cost must
+            # not scale with total capacity C (the wheel's whole point)
+            self.overflow_capacity = (
+                max(128, min(1024, capacity // 8))
+                if overflow_capacity is None
+                else overflow_capacity
+            )
+
     # -- state construction (host-side) -------------------------------------
     def init_state(self, cols: dict, seed: int, proto: Any, down=None) -> SimState:
         """Build a fresh single-replica state from node columns
         (core.node.build_node_columns output).  `down` marks nodes dead from
         t=0 — applied before the protocol's initial emissions so sends to
         them are dropped like the oracle's send-time check."""
-        n, c, p = self.n_nodes, self.capacity, self.payload_width
+        n, p = self.n_nodes, self.payload_width
+        w, b, v = self.wheel_rows, self.wheel_slots, self.overflow_capacity
         zi = lambda shape: jnp.zeros(shape, dtype=jnp.int32)
         state = SimState(
             time=jnp.int32(0),
@@ -152,12 +227,19 @@ class BatchedNetwork:
             extra_latency=jnp.asarray(cols["extra_latency"], jnp.int32),
             city_idx=jnp.asarray(cols.get("city_idx", np.full(n, -1)), jnp.int32),
             partition_x=jnp.full(MAX_PARTITIONS, INT_MAX, dtype=jnp.int32),
-            msg_valid=jnp.zeros(c, dtype=bool),
-            msg_arrival=jnp.full(c, INT_MAX, dtype=jnp.int32),
-            msg_from=zi(c),
-            msg_to=zi(c),
-            msg_type=zi(c),
-            msg_payload=zi((c, p)),
+            msg_valid=jnp.zeros((w, b), dtype=bool),
+            msg_arrival=jnp.full((w, b), INT_MAX, dtype=jnp.int32),
+            msg_from=zi((w, b)),
+            msg_to=zi((w, b)),
+            msg_type=zi((w, b)),
+            msg_payload=zi((w, b, p)),
+            whl_fill=zi(w),
+            ovf_valid=jnp.zeros(v, dtype=bool),
+            ovf_arrival=jnp.full(v, INT_MAX, dtype=jnp.int32),
+            ovf_from=zi(v),
+            ovf_to=zi(v),
+            ovf_type=zi(v),
+            ovf_payload=zi((v, p)),
             msg_head=jnp.int32(0),
             dropped=jnp.int32(0),
             proto=proto,
@@ -165,6 +247,30 @@ class BatchedNetwork:
         for em in self.protocol.initial_emissions(self, state):
             state = self.apply_emission(state, em)
         return state
+
+    def cache_key(self) -> tuple:
+        """Explicit identity for compiled-program caches (parallel
+        .replica_shard): protocol name + the static knobs that shape the
+        trace.  id(protocol)/id(latency) disambiguate instances carrying
+        different behavior params; cached programs keep those objects
+        alive, so the ids cannot be recycled while an entry lives."""
+        mesh = getattr(self, "node_mesh", None)
+        return (
+            type(self.protocol).__name__,
+            repr(getattr(self.protocol, "params", None)),
+            id(self.protocol),
+            id(self.latency),
+            str(self.latency),
+            self.n_nodes,
+            self.capacity,
+            self.wheel_rows,
+            self.wheel_slots,
+            self.overflow_capacity,
+            int(self.msg_discard_time),
+            type(self.throughput).__name__ if self.throughput else None,
+            getattr(self, "node_axis", None),
+            id(mesh) if mesh is not None else None,
+        )
 
     # -- partitions (Network.partition, Network.java:693-707) ----------------
     @staticmethod
@@ -195,14 +301,22 @@ class BatchedNetwork:
             send_ctr=state.send_ctr + 1,
         )
         # per-event seed: the batched analog of rd.nextInt() per send;
-        # send_ctr + row index decorrelate same-tick same-type sends
+        # send_ctr decorrelates same-tick emissions, to_idx the rows of
+        # one emission.  The destination id — NOT the row position — is
+        # the per-row key so the draw is invariant to message-store
+        # layout (flat ring vs time wheel order the delivery view
+        # differently; a position-keyed seed would make reply latencies
+        # depend on storage slots).  Known approximation: duplicate
+        # (from, to, type) rows within ONE emission share a draw, where
+        # the reference would draw twice — same-dest duplicate sends in
+        # a single multicast, which the protocols don't emit.
         seed = hash32(
             state.seed,
             send_time,
             from_idx,
             mtype,
             state.send_ctr,
-            jnp.arange(k, dtype=jnp.int32),
+            to_idx,
         )
         delta = pseudo_delta(to_idx, seed)
         static = LatencyStatic(state.x, state.y, state.extra_latency, state.city_idx)
@@ -227,6 +341,13 @@ class BatchedNetwork:
         return state, ok, arrival
 
     def apply_emission(self, state: SimState, em: Emission) -> SimState:
+        """Scatter an emission's ok-rows into the message store: wheel
+        bucket `eff_arrival mod W` when the arrival is inside the horizon
+        (t, t+W], overflow lane otherwise (or on full-row spill).  Wheel
+        rows stay a dense prefix — a row is only ever cleared whole (or
+        repacked) at delivery, so the next free slot is whl_fill[row] plus
+        this call's same-row rank.  Only a genuinely full store drops, and
+        it drops the NEW rows, counted in `dropped`."""
         k = em.mask.shape[0]
         send_time = em.send_time if em.send_time is not None else state.time + 1
         mask = em.mask
@@ -245,84 +366,223 @@ class BatchedNetwork:
                 state, mask, from_idx, to_idx, send_time, mtype
             )
 
-        # pack the ok-messages into FREE ring slots: the k-th ok row takes
-        # the k-th invalid slot.  (A head cursor would clobber still-pending
-        # long-lived messages — ENR's birth/exit wakes, scheduled tasks —
-        # as soon as cumulative traffic wraps the capacity, even with most
-        # slots free.)  Only a genuinely full ring drops, and it drops the
-        # NEW rows, counted in `dropped`.
-        free = ~state.msg_valid  # [C]
-        free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
-        slot_of_rank = jnp.full(self.capacity + 1, self.capacity, jnp.int32)
-        slot_of_rank = slot_of_rank.at[
-            jnp.where(free, free_rank, self.capacity)
-        ].set(jnp.arange(self.capacity, dtype=jnp.int32), mode="drop")
-        n_free = jnp.sum(free.astype(jnp.int32))
-        slot_rank = jnp.cumsum(ok.astype(jnp.int32)) - 1
-        fits = ok & (slot_rank < n_free)
-        pos = jnp.where(
-            fits,
-            slot_of_rank[jnp.clip(slot_rank, 0, self.capacity)],
-            jnp.int32(self.capacity),  # OOB -> dropped
-        )
-        n_ok = jnp.sum(ok.astype(jnp.int32))
-        overwritten = jnp.sum((ok & ~fits).astype(jnp.int32))
         payload = em.payload
         if self.payload_width and payload is None:
             payload = jnp.zeros((k, self.payload_width), dtype=jnp.int32)
-        new = state._replace(
-            msg_valid=state.msg_valid.at[pos].set(True, mode="drop"),
-            msg_arrival=state.msg_arrival.at[pos].set(arrival, mode="drop"),
-            msg_from=state.msg_from.at[pos].set(from_idx, mode="drop"),
-            msg_to=state.msg_to.at[pos].set(to_idx, mode="drop"),
-            msg_type=state.msg_type.at[pos].set(
-                jnp.broadcast_to(mtype, (k,)), mode="drop"
-            ),
-            # head is no longer an allocator (free-slot packing above); kept
-            # as a monotone sent-message counter for observability
+        mtype_rows = jnp.broadcast_to(mtype, (k,)).astype(jnp.int32)
+        n_ok = jnp.sum(ok.astype(jnp.int32))
+        t = state.time
+        w, b, v = self.wheel_rows, self.wheel_slots, self.overflow_capacity
+
+        if self.flat:
+            to_ovf = ok
+        else:
+            # routing tick: stale arrivals (<= t, possible via explicit
+            # arrivals after a clock skip) deliver next tick like the flat
+            # ring; arrival == t + W is safe because the current row is
+            # delivered/cleared before emissions are applied
+            eff = jnp.maximum(arrival, t + 1)
+            cand = ok & (eff <= t + w)
+            row = jnp.remainder(eff, w)
+            # same-row rank via sort (ties broadcast to distinct slots)
+            rkey = jnp.where(cand, row, w)
+            order = jnp.argsort(rkey)
+            rsort = rkey[order]
+            pos_sorted = jnp.arange(k, dtype=jnp.int32) - jnp.searchsorted(
+                rsort, rsort, side="left"
+            ).astype(jnp.int32)
+            rank = jnp.zeros(k, jnp.int32).at[order].set(pos_sorted)
+            slot = state.whl_fill[jnp.where(cand, row, 0)] + rank
+            fits = cand & (slot < b)
+            w_row = jnp.where(fits, row, w)  # OOB -> dropped scatter
+            w_slot = jnp.where(fits, slot, 0)
+            state = state._replace(
+                msg_valid=state.msg_valid.at[w_row, w_slot].set(True, mode="drop"),
+                msg_arrival=state.msg_arrival.at[w_row, w_slot].set(
+                    arrival, mode="drop"
+                ),
+                msg_from=state.msg_from.at[w_row, w_slot].set(from_idx, mode="drop"),
+                msg_to=state.msg_to.at[w_row, w_slot].set(to_idx, mode="drop"),
+                msg_type=state.msg_type.at[w_row, w_slot].set(
+                    mtype_rows, mode="drop"
+                ),
+                whl_fill=state.whl_fill.at[w_row].add(
+                    fits.astype(jnp.int32), mode="drop"
+                ),
+            )
+            if self.payload_width:
+                state = state._replace(
+                    msg_payload=state.msg_payload.at[w_row, w_slot].set(
+                        payload, mode="drop"
+                    )
+                )
+            to_ovf = ok & ~fits  # beyond horizon, or full-row spill
+
+        # overflow lane: pack into FREE slots, k-th ok row takes the k-th
+        # invalid slot (a head cursor would clobber still-pending long-lived
+        # messages — ENR's wakes, Casper's slot calendar — once cumulative
+        # traffic wraps the capacity, even with most slots free)
+        free = ~state.ovf_valid  # [V]
+        free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+        slot_of_rank = jnp.full(v + 1, v, jnp.int32)
+        slot_of_rank = slot_of_rank.at[
+            jnp.where(free, free_rank, v)
+        ].set(jnp.arange(v, dtype=jnp.int32), mode="drop")
+        n_free = jnp.sum(free.astype(jnp.int32))
+        orank = jnp.cumsum(to_ovf.astype(jnp.int32)) - 1
+        ofits = to_ovf & (orank < n_free)
+        pos = jnp.where(
+            ofits,
+            slot_of_rank[jnp.clip(orank, 0, v)],
+            jnp.int32(v),  # OOB -> dropped
+        )
+        overwritten = jnp.sum((to_ovf & ~ofits).astype(jnp.int32))
+        state = state._replace(
+            ovf_valid=state.ovf_valid.at[pos].set(True, mode="drop"),
+            ovf_arrival=state.ovf_arrival.at[pos].set(arrival, mode="drop"),
+            ovf_from=state.ovf_from.at[pos].set(from_idx, mode="drop"),
+            ovf_to=state.ovf_to.at[pos].set(to_idx, mode="drop"),
+            ovf_type=state.ovf_type.at[pos].set(mtype_rows, mode="drop"),
+            # head is not an allocator; kept as a monotone sent-message
+            # counter for observability
             msg_head=state.msg_head + n_ok,
             dropped=state.dropped + overwritten,
         )
         if self.payload_width:
-            new = new._replace(
-                msg_payload=new.msg_payload.at[pos].set(payload, mode="drop")
+            state = state._replace(
+                ovf_payload=state.ovf_payload.at[pos].set(payload, mode="drop")
             )
-        return new
+        return state
 
     def apply_emissions(self, state: SimState, emissions) -> SimState:
         for em in emissions:
             state = self.apply_emission(state, em)
         return state
 
-    # -- one millisecond (receiveUntil body, Network.java:586-632) -----------
-    def _step_core(self, state: SimState) -> SimState:
-        """One tick WITHOUT the time advance and WITHOUT tick_beat: ring
-        delivery + protocol.tick.  run_ms_batched's beat path guards
-        tick_beat separately with a real branch."""
+    # -- delivery ------------------------------------------------------------
+    def _window(self) -> int:
+        """Wheel rows gathered per step: TIME_QUANTUM consecutive rows so a
+        quantum-coarsened step delivers its whole window (t-q, t] at once;
+        1 in flat mode (the overflow scan is already exact)."""
+        if self.flat:
+            return 1
+        q = max(1, int(self.protocol.TIME_QUANTUM))
+        if q > self.wheel_rows:
+            raise ValueError(
+                f"TIME_QUANTUM={q} exceeds wheel_rows={self.wheel_rows}; "
+                "raise wheel_rows or use flat mode (wheel_rows=0)"
+            )
+        return q
+
+    def _deliver_and_clear(self, state: SimState):
+        """One tick's delivery: gather the due view (window rows + overflow
+        lane), update receiver counters, run protocol.deliver on the view,
+        then clear delivered entries and repack the visited rows to a dense
+        prefix.  Returns (state, emissions)."""
         t = state.time
-        due = state.msg_valid & (state.msg_arrival <= t)
+        w, b = self.wheel_rows, self.wheel_slots
+        q = self._window()
+        rows = jnp.remainder(
+            t - q + 1 + jnp.arange(q, dtype=jnp.int32), jnp.int32(w)
+        )  # [q] distinct rows covering ticks (t-q, t]
+        wv = state.msg_valid[rows]  # [q, B]
+        wa = state.msg_arrival[rows]
+        wf = state.msg_from[rows]
+        wt = state.msg_to[rows]
+        wk = state.msg_type[rows]
+        wp = state.msg_payload[rows]  # [q, B, P]
+
+        view_valid = jnp.concatenate([wv.reshape(-1), state.ovf_valid])
+        view_arrival = jnp.concatenate([wa.reshape(-1), state.ovf_arrival])
+        view_from = jnp.concatenate([wf.reshape(-1), state.ovf_from])
+        view_to = jnp.concatenate([wt.reshape(-1), state.ovf_to])
+        view_type = jnp.concatenate([wk.reshape(-1), state.ovf_type])
+        view_payload = jnp.concatenate(
+            [wp.reshape(q * b, -1), state.ovf_payload], axis=0
+        )
+
+        due = view_valid & (view_arrival <= t)
         # delivery-time checks: down destination or cross-partition messages
         # are discarded on arrival (Network.java:606, :518-520)
-        pid_f = self.partition_id(state, state.x[state.msg_from])
-        pid_t = self.partition_id(state, state.x[state.msg_to])
-        deliver = due & ~state.down[state.msg_to] & (pid_f == pid_t)
+        pid_f = self.partition_id(state, state.x[view_from])
+        pid_t = self.partition_id(state, state.x[view_to])
+        deliver = due & ~state.down[view_to] & (pid_f == pid_t)
 
         # receiver counters skip size-0 (task-style) types, mirroring the
         # Task exemption at Network.java:522-526
-        sizes = jnp.asarray(self._msg_sizes, jnp.int32)[state.msg_type]
+        sizes = jnp.asarray(self._msg_sizes, jnp.int32)[view_type]
         dm = (deliver & (sizes > 0)).astype(jnp.int32)
         state = state._replace(
-            msg_received=state.msg_received.at[state.msg_to].add(dm, mode="drop"),
-            bytes_received=state.bytes_received.at[state.msg_to].add(
+            msg_received=state.msg_received.at[view_to].add(dm, mode="drop"),
+            bytes_received=state.bytes_received.at[view_to].add(
                 dm * sizes, mode="drop"
             ),
         )
 
-        state, emissions = self.protocol.deliver(self, state, deliver)
-        state = state._replace(msg_valid=state.msg_valid & ~due)
-        state = self.apply_emissions(state, emissions)
+        # hand the protocol a view-state whose msg_* columns are the flat
+        # [D] gathers; protocols must not touch msg_* (the engine owns the
+        # store), so the wheel fields are restored below
+        vstate = state._replace(
+            msg_valid=view_valid,
+            msg_arrival=view_arrival,
+            msg_from=view_from,
+            msg_to=view_to,
+            msg_type=view_type,
+            msg_payload=view_payload,
+        )
+        pstate, emissions = self.protocol.deliver(self, vstate, deliver)
 
+        # clear due entries; surviving entries (a row visited early by a
+        # quantum window) repack to the slot prefix so whl_fill stays the
+        # next-free-slot index
+        keep = wv & ~due[: q * b].reshape(q, b)
+        pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+        tgt = jnp.where(keep, pos, b)  # OOB -> dropped scatter
+        ii = jnp.arange(q, dtype=jnp.int32)[:, None]
+        nv = jnp.zeros_like(wv).at[ii, tgt].set(keep, mode="drop")
+        na = jnp.full_like(wa, INT_MAX).at[ii, tgt].set(wa, mode="drop")
+        nf = jnp.zeros_like(wf).at[ii, tgt].set(wf, mode="drop")
+        nt = jnp.zeros_like(wt).at[ii, tgt].set(wt, mode="drop")
+        nk = jnp.zeros_like(wk).at[ii, tgt].set(wk, mode="drop")
+        state = pstate._replace(
+            msg_valid=state.msg_valid.at[rows].set(nv),
+            msg_arrival=state.msg_arrival.at[rows].set(na),
+            msg_from=state.msg_from.at[rows].set(nf),
+            msg_to=state.msg_to.at[rows].set(nt),
+            msg_type=state.msg_type.at[rows].set(nk),
+            msg_payload=state.msg_payload,
+            whl_fill=state.whl_fill.at[rows].set(
+                jnp.sum(keep.astype(jnp.int32), axis=1)
+            ),
+            ovf_valid=state.ovf_valid & ~due[q * b :],
+        )
+        if self.payload_width:
+            np_ = jnp.zeros_like(wp).at[ii, tgt].set(wp, mode="drop")
+            state = state._replace(
+                msg_payload=state.msg_payload.at[rows].set(np_)
+            )
+        return state, emissions
+
+    # -- one millisecond (receiveUntil body, Network.java:586-632) -----------
+    def _step_core(self, state: SimState) -> SimState:
+        """One tick WITHOUT the time advance and WITHOUT tick_beat: wheel
+        delivery + protocol.tick.  run_ms_batched's beat path guards
+        tick_beat separately with a real branch."""
+        state, emissions = self._deliver_and_clear(state)
+        state = self.apply_emissions(state, emissions)
         return self.protocol.tick(self, state)
+
+    # -- phase hooks (bench --phase-profile) ---------------------------------
+    def _phase_deliver(self, state: SimState) -> SimState:
+        """Delivery + clear only (emissions discarded) — the per-tick cost
+        that the time wheel bounds at O(window*B + V) instead of O(C)."""
+        state, _ = self._deliver_and_clear(state)
+        return state
+
+    def _phase_deliver_apply(self, state: SimState) -> SimState:
+        """Delivery + emission apply (protocol.tick excluded)."""
+        state, emissions = self._deliver_and_clear(state)
+        return self.apply_emissions(state, emissions)
 
     def step(self, state: SimState) -> SimState:
         state = self._step_core(state)
@@ -330,21 +590,62 @@ class BatchedNetwork:
         state = self.protocol.tick_post(self, state)
         return state._replace(time=state.time + 1)
 
+    # -- occupancy summaries --------------------------------------------------
+    def _wheel_next_arrival(self, state: SimState) -> jnp.ndarray:
+        """Earliest tick >= state.time with an occupied wheel row: the
+        occupancy bitmap (whl_fill > 0, packed uint32 words) rotated to
+        start at the current tick, then a first-set-bit scan over W/32
+        words — O(W) instead of a min over all W*B slots.  Row candidates
+        equal the true arrival for in-horizon entries and never overshoot
+        for stale ones, so jumps never skip a pending message."""
+        t = state.time
+        w = self.wheel_rows
+        occ = state.whl_fill > 0  # [W]
+        rot = occ[jnp.remainder(t + jnp.arange(w, dtype=jnp.int32), jnp.int32(w))]
+        words = pack_bool_words(rot)
+        d = lowest_set_bit(words)
+        return jnp.where(jnp.any(rot), t + d, INT_MAX).astype(jnp.int32)
+
+    def pending_messages(self, state: SimState) -> jnp.ndarray:
+        """Quiescence summary: occupied wheel rows (popcount over the
+        packed occupancy words) + live overflow entries.  Zero iff no
+        message is pending — the DES "event queue empty" test."""
+        ovf = jnp.sum(state.ovf_valid.astype(jnp.int32))
+        if self.flat:
+            return ovf
+        return popcount_words(pack_bool_words(state.whl_fill > 0)) + ovf
+
+    def occupancy(self, state: SimState) -> dict:
+        """Observability: wheel fill high-water and overflow census of the
+        CURRENT state (bench's occupancy probe samples this per tick)."""
+        return {
+            "wheel_fill_max": jnp.max(state.whl_fill),
+            "overflow_count": jnp.sum(state.ovf_valid.astype(jnp.int32)),
+        }
+
     def _step_jump(self, state: SimState, end) -> SimState:
         """step() plus empty-ms skipping: when the protocol has no per-ms
         tick work (TICK_INTERVAL None), jump straight to the next arrival —
         the batched analog of the oracle's event loop skipping idle time
         (nextMessage's per-ms poll, Network.java:533-545, exists only
-        because conditional tasks poll empty milliseconds).  A protocol
+        because conditional tasks poll empty milliseconds).  The next
+        arrival comes from the wheel's occupancy-word scan plus a min over
+        the small overflow lane — O(W + V), not O(C).  A protocol
         TIME_QUANTUM > 1 additionally rounds the jump target UP to the
         quantum grid, so a whole window of arrivals is delivered in one
         step (each delayed < quantum ms)."""
         state = self.step(state)
         if self.protocol.TICK_INTERVAL is None:
             q = self.protocol.TIME_QUANTUM
-            next_arrival = jnp.min(
-                jnp.where(state.msg_valid, state.msg_arrival, INT_MAX)
+            ovf_next = jnp.min(
+                jnp.where(state.ovf_valid, state.ovf_arrival, INT_MAX)
             )
+            if self.flat:
+                next_arrival = ovf_next
+            else:
+                next_arrival = jnp.minimum(
+                    self._wheel_next_arrival(state), ovf_next
+                )
             t = jnp.clip(next_arrival, state.time, end).astype(jnp.int32)
             if q > 1:
                 t = jnp.minimum(
@@ -354,23 +655,17 @@ class BatchedNetwork:
         return state
 
     # -- the loop ------------------------------------------------------------
-    @functools.partial(jax.jit, static_argnums=(0, 2, 3))
-    def run_ms(self, state: SimState, ms: int, stop_when_done: bool = False) -> SimState:
-        """Advance `ms` simulated milliseconds (ticks [time, time+ms)).
-
-        stop_when_done=True adds the protocol's `all_done` predicate to the
-        loop condition: once the observable outcome is decided (e.g. every
-        live Handel node aggregated), remaining ticks are skipped and the
-        clock jumps to `end` — the batched analog of the oracle DES going
-        quiescent when no events remain.  Post-done side effects (periodic
-        re-offers' traffic counters) are NOT simulated, so keep the default
-        for traffic-parity runs."""
+    def _run_ms_impl(self, state: SimState, ms: int, stop_when_done: bool) -> SimState:
         end = state.time + ms
 
         def cond(s):
             c = s.time < end
             if stop_when_done:
                 c = c & ~self.protocol.all_done(s)
+                if self.protocol.TICK_INTERVAL is None:
+                    # quiescence: no pending message and no per-ms tick
+                    # work means nothing can ever change — stop scanning
+                    c = c & (self.pending_messages(s) > 0)
             return c
 
         def body(s):
@@ -380,24 +675,42 @@ class BatchedNetwork:
         return state._replace(time=end)
 
     @functools.partial(jax.jit, static_argnums=(0, 2, 3))
-    def run_ms_batched(
-        self, states: SimState, ms: int, stop_when_done: bool = False
+    def _run_ms(self, state: SimState, ms: int, stop_when_done: bool) -> SimState:
+        return self._run_ms_impl(state, ms, stop_when_done)
+
+    @functools.partial(jax.jit, static_argnums=(0, 2, 3), donate_argnums=(1,))
+    def _run_ms_donated(
+        self, state: SimState, ms: int, stop_when_done: bool
     ) -> SimState:
-        """vmapped run over the leading replica axis — the TPU replacement
-        for RunMultipleTimes' sequential reseeded loop.
+        return self._run_ms_impl(state, ms, stop_when_done)
 
-        When the protocol declares a sparse beat structure (BEAT_PERIOD +
-        BEAT_RESIDUES), the time loop runs OUTSIDE the vmap: replicas
-        advance time in lockstep, so the tick index is replica-uniform and
-        tick_beat can be guarded by a real lax.cond — off-beat ticks skip
-        the periodic work instead of executing it masked (a vmapped
-        lax.cond would execute both branches).
+    def run_ms(
+        self,
+        state: SimState,
+        ms: int,
+        stop_when_done: bool = False,
+        donate: bool = False,
+    ) -> SimState:
+        """Advance `ms` simulated milliseconds (ticks [time, time+ms)).
 
-        stop_when_done stops the LOCKSTEP loop once every replica's
-        all_done holds (see run_ms).  On the ungated fallback path the
-        flag is semantics-only: vmapped while_loops mask finished lanes
-        rather than skip them, so the body runs until the SLOWEST replica
-        finishes either way."""
+        stop_when_done=True adds the protocol's `all_done` predicate to the
+        loop condition: once the observable outcome is decided (e.g. every
+        live Handel node aggregated), remaining ticks are skipped and the
+        clock jumps to `end` — the batched analog of the oracle DES going
+        quiescent when no events remain.  Post-done side effects (periodic
+        re-offers' traffic counters) are NOT simulated, so keep the default
+        for traffic-parity runs.
+
+        donate=True donates the input state's buffers to the compiled call
+        (chunked drivers that overwrite `state` each chunk stop paying a
+        full state copy per chunk).  The input is INVALID afterwards —
+        callers that reuse it must keep the default."""
+        fn = self._run_ms_donated if donate else self._run_ms
+        return fn(state, ms, stop_when_done)
+
+    def _run_ms_batched_impl(
+        self, states: SimState, ms: int, stop_when_done: bool
+    ) -> SimState:
         proto = self.protocol
         period, residues = proto.BEAT_PERIOD, proto.BEAT_RESIDUES
         if (
@@ -406,7 +719,9 @@ class BatchedNetwork:
             or residues is None
             or len(residues) >= period
         ):
-            return jax.vmap(lambda s: self.run_ms(s, ms, stop_when_done))(states)
+            return jax.vmap(
+                lambda s: self._run_ms_impl(s, ms, stop_when_done)
+            )(states)
 
         step_v = jax.vmap(self._step_core)
         beat_v = jax.vmap(lambda s: proto.tick_beat(self, s))
@@ -447,6 +762,66 @@ class BatchedNetwork:
         i_fin, states = lax.while_loop(w_cond, w_body, (jnp.int32(0), states))
         # normalize the lockstep clocks to the full horizon, like run_ms
         return states._replace(time=states.time + (ms - i_fin))
+
+    @functools.partial(jax.jit, static_argnums=(0, 2, 3))
+    def _run_ms_batched(
+        self, states: SimState, ms: int, stop_when_done: bool
+    ) -> SimState:
+        return self._run_ms_batched_impl(states, ms, stop_when_done)
+
+    @functools.partial(jax.jit, static_argnums=(0, 2, 3), donate_argnums=(1,))
+    def _run_ms_batched_donated(
+        self, states: SimState, ms: int, stop_when_done: bool
+    ) -> SimState:
+        return self._run_ms_batched_impl(states, ms, stop_when_done)
+
+    def run_ms_batched(
+        self,
+        states: SimState,
+        ms: int,
+        stop_when_done: bool = False,
+        donate: bool = False,
+    ) -> SimState:
+        """vmapped run over the leading replica axis — the TPU replacement
+        for RunMultipleTimes' sequential reseeded loop.
+
+        When the protocol declares a sparse beat structure (BEAT_PERIOD +
+        BEAT_RESIDUES), the time loop runs OUTSIDE the vmap: replicas
+        advance time in lockstep, so the tick index is replica-uniform and
+        tick_beat can be guarded by a real lax.cond — off-beat ticks skip
+        the periodic work instead of executing it masked (a vmapped
+        lax.cond would execute both branches).
+
+        stop_when_done stops the LOCKSTEP loop once every replica's
+        all_done holds (see run_ms).  On the ungated fallback path the
+        flag is semantics-only: vmapped while_loops mask finished lanes
+        rather than skip them, so the body runs until the SLOWEST replica
+        finishes either way.
+
+        donate=True: see run_ms — the input pytree is consumed."""
+        fn = self._run_ms_batched_donated if donate else self._run_ms_batched
+        return fn(states, ms, stop_when_done)
+
+    @functools.partial(jax.jit, static_argnums=(0, 2))
+    def run_ms_occupancy(self, state: SimState, ms: int):
+        """Instrumented single-replica run: `ms` plain per-tick steps (no
+        empty-ms jumps, so every tick's occupancy is sampled) returning
+        (state, {wheel_fill_hwm, overflow_hwm}) — the wheel's high-water
+        marks for bench's --phase-profile record."""
+
+        def body(_, carry):
+            s, hw_fill, hw_ovf = carry
+            s = self.step(s)
+            hw_fill = jnp.maximum(hw_fill, jnp.max(s.whl_fill))
+            hw_ovf = jnp.maximum(
+                hw_ovf, jnp.sum(s.ovf_valid.astype(jnp.int32))
+            )
+            return (s, hw_fill, hw_ovf)
+
+        state, hw_fill, hw_ovf = lax.fori_loop(
+            0, ms, body, (state, jnp.int32(0), jnp.int32(0))
+        )
+        return state, {"wheel_fill_hwm": hw_fill, "overflow_hwm": hw_ovf}
 
 
 def replicate_state(state: SimState, n_replicas: int, seeds=None) -> SimState:

@@ -30,6 +30,36 @@ def popcount_words(words) -> jnp.ndarray:
     )
 
 
+def pack_bool_words(bits) -> jnp.ndarray:
+    """Pack a bool vector into uint32 words over the last axis:
+    [..., W] bool -> [..., ceil(W/32)] uint32, bit j of word k = element
+    32k + j.  (The engine's wheel-occupancy summary; pairs with
+    popcount_words / lowest_set_bit.)"""
+    bits = jnp.asarray(bits, bool)
+    w = bits.shape[-1]
+    pad = (-w) % WORD
+    if pad:
+        bits = jnp.concatenate(
+            [bits, jnp.zeros(bits.shape[:-1] + (pad,), bool)], axis=-1
+        )
+    grouped = bits.reshape(bits.shape[:-1] + ((w + pad) // WORD, WORD))
+    weights = (jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32))
+    return jnp.sum(grouped.astype(jnp.uint32) * weights, axis=-1).astype(
+        jnp.uint32
+    )
+
+
+def lowest_set_bit(words) -> jnp.ndarray:
+    """Index of the lowest set bit over the last axis of packed [..., w]
+    uint32 vectors (undefined when empty — gate on popcount > 0)."""
+    words = words.astype(jnp.uint32)
+    word_nz = words != 0
+    widx = jnp.argmax(word_nz, axis=-1).astype(jnp.int32)
+    wval = jnp.take_along_axis(words, widx[..., None], axis=-1)[..., 0]
+    lowbit = popcount_words(((wval & (-wval).astype(jnp.uint32)) - 1)[..., None])
+    return widx * WORD + lowbit
+
+
 def xor_shuffle(words, v):
     """Permute bit positions j -> j ^ v of packed vectors.
 

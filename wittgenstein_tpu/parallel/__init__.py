@@ -25,11 +25,18 @@ from .node_shard import (
     run_ms_node_sharded,
     shard_state_by_node,
 )
-from .replica_shard import shard_replicas, sharded_run_stats
+from .replica_shard import (
+    clear_run_cache,
+    run_cache_info,
+    shard_replicas,
+    sharded_run_stats,
+)
 
 __all__ = [
+    "clear_run_cache",
     "enable_node_sharding",
     "node_shard_bytes",
+    "run_cache_info",
     "run_ms_node_sharded",
     "shard_state_by_node",
     "shard_replicas",

@@ -93,21 +93,38 @@ def node_shard_bytes(state, n: int):
     return out
 
 
+# engine-owned message-store fields of SimState: the time wheel [W, B],
+# its fill/occupancy summary [W] and the overflow lane [V] are indexed by
+# arrival tick, not by node — they must be replicated even when a wheel
+# dimension coincides with n_nodes.  (msg_received/msg_sent are NODE
+# columns and deliberately absent.)
+_MESSAGE_STORE_FIELDS = (
+    ".msg_valid", ".msg_arrival", ".msg_from", ".msg_to", ".msg_type",
+    ".msg_payload", ".whl_fill", ".ovf_valid", ".ovf_arrival", ".ovf_from",
+    ".ovf_to", ".ovf_type", ".ovf_payload",
+)
+
+
 def shard_state_by_node(net, state, mesh: Mesh, axis: str = "nodes"):
     """Place ONE simulation's state onto the mesh with every [N, ...]
     array (leading dim == n_nodes) sharded over `axis` and everything
-    else (scalars, the message ring, static tables) replicated."""
+    else (scalars, the time-wheel message store, static tables)
+    replicated.  Store fields are excluded BY NAME — the wheel's [W, B]
+    shape can coincide with n_nodes without being node-indexed."""
     n = net.n_nodes
     row_sharding = NamedSharding(mesh, P(axis))
     rep_sharding = NamedSharding(mesh, P())
 
-    def put(a):
+    def put(path, a):
         a = jnp.asarray(a)
+        key = jax.tree_util.keystr(path)
+        if any(f in key for f in _MESSAGE_STORE_FIELDS):
+            return jax.device_put(a, rep_sharding)
         if a.ndim >= 1 and a.shape[0] == n:
             return jax.device_put(a, row_sharding)
         return jax.device_put(a, rep_sharding)
 
-    return jax.tree_util.tree_map(put, state)
+    return jax.tree_util.tree_map_with_path(put, state)
 
 
 def run_ms_node_sharded(net, state, ms: int):

@@ -11,7 +11,7 @@ per-replica state ever happens.
 
 from __future__ import annotations
 
-import functools
+from collections import OrderedDict
 from typing import Tuple
 
 import jax
@@ -26,10 +26,35 @@ def shard_replicas(states, mesh: Mesh, axis: str = "replicas"):
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), states)
 
 
-@functools.lru_cache(maxsize=64)
+# compiled-program cache, keyed EXPLICITLY on (net.cache_key(), sim_ms) —
+# protocol name + static engine knobs (see BatchedNetwork.cache_key) —
+# instead of hashing the network object through lru_cache.  Bounded FIFO
+# with a clear hook: long sweep campaigns that churn through many configs
+# can flush it (clear_run_cache) rather than pinning 64 full jit programs
+# (and the engines/latency tables their closures hold) for process life.
+_RUN_CACHE: "OrderedDict[tuple, object]" = OrderedDict()
+_RUN_CACHE_MAX = 64
+
+
+def clear_run_cache() -> None:
+    """Drop every cached compiled run program (the lru_cache.cache_clear
+    analog for long campaigns)."""
+    _RUN_CACHE.clear()
+
+
+def run_cache_info() -> dict:
+    return {"size": len(_RUN_CACHE), "maxsize": _RUN_CACHE_MAX}
+
+
 def _run_and_reduce(net, sim_ms: int):
-    """One compiled program per (net, sim_ms): repeated calls with the same
-    network hit the jit cache instead of re-tracing the full simulation."""
+    """One compiled program per (net.cache_key(), sim_ms): repeated calls
+    with an equivalent network hit the cache instead of re-tracing the
+    full simulation."""
+    key = (net.cache_key(), int(sim_ms))
+    fn = _RUN_CACHE.get(key)
+    if fn is not None:
+        _RUN_CACHE.move_to_end(key)
+        return fn
 
     @jax.jit
     def fn(s):
@@ -46,6 +71,9 @@ def _run_and_reduce(net, sim_ms: int):
         }
         return out, stats
 
+    _RUN_CACHE[key] = fn
+    while len(_RUN_CACHE) > _RUN_CACHE_MAX:
+        _RUN_CACHE.popitem(last=False)
     return fn
 
 

@@ -49,7 +49,7 @@ import numpy as np
 from jax import lax
 
 from ..engine import BatchedProtocol
-from ..ops.bitops import popcount_words, xor_shuffle
+from ..ops.bitops import lowest_set_bit, popcount_words, xor_shuffle
 
 INT32_MAX = np.int32(2**31 - 1)
 MAX_NODES = 1 << 14  # int32 key-packing headroom
@@ -232,12 +232,9 @@ class BitsetAggBase(BatchedProtocol):
     @staticmethod
     def _lowest_bit(words):
         """Index of the lowest set bit over the last axis of packed [..., w]
-        uint32 vectors (undefined when empty — gate on popcount > 0)."""
-        word_nz = words != 0
-        widx = jnp.argmax(word_nz, axis=-1).astype(jnp.int32)
-        wval = jnp.take_along_axis(words, widx[..., None], axis=-1)[..., 0]
-        lowbit = popcount_words(((wval & (-wval).astype(jnp.uint32)) - 1)[..., None])
-        return widx * 32 + lowbit
+        uint32 vectors (undefined when empty — gate on popcount > 0).
+        Shared with the engine's wheel-occupancy scan (ops.bitops)."""
+        return lowest_set_bit(words)
 
     def _getbit(self, x, pos):
         """Bit `pos` of full-width [N, W] vectors; pos is [N, ...] int32."""
@@ -489,6 +486,15 @@ class BitsetAggBase(BatchedProtocol):
         except ImportError:  # pragma: no cover
             from jax.experimental.shard_map import shard_map as _shard_map
 
+        import inspect
+
+        # the replication-check kwarg was renamed check_rep -> check_vma;
+        # pick whichever this jax accepts
+        _sig = inspect.signature(_shard_map).parameters
+        _check_kw = {
+            "check_vma" if "check_vma" in _sig else "check_rep": False
+        }
+
         proto = state.proto
         n, d = self.n_nodes, self.CHANNEL_DEPTH
         ss = d + 1
@@ -523,7 +529,7 @@ class BitsetAggBase(BatchedProtocol):
             mesh=mesh,
             in_specs=tuple(in_specs),
             out_specs=tuple(out_specs),
-            check_vma=False,
+            **_check_kw,
         )
         def island(meta_l, *rest):
             cnts = rest[:nb]

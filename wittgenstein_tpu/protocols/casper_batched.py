@@ -659,6 +659,9 @@ def make_casper(
         # to 1.5 waves (the default 20x4 config keeps the old 1<<14)
         wave = apr * n + 4 * n
         capacity = max(1 << 14, 1 << int(np.ceil(np.log2(1.5 * wave))))
-    net = BatchedNetwork(proto, latency, n, capacity=capacity)
+    # flat mode (wheel_rows=0): Casper's scheduling is dominated by
+    # explicit-arrival self-messages whole 8 s slots ahead — far beyond any
+    # useful wheel horizon, so the exact overflow-lane scan IS the store
+    net = BatchedNetwork(proto, latency, n, capacity=capacity, wheel_rows=0)
     state = net.init_state(cols, seed=seed, proto=proto.proto_init(n))
     return net, state

@@ -468,7 +468,9 @@ def make_enr(
     while len(nodes) < m:
         nodes.append(Node(rd, nb))
     cols = build_node_columns(nodes, city_index)
-    net = BatchedNetwork(proto, latency, m, capacity=capacity)
+    # flat mode: the wake calendar schedules explicit arrivals up to the
+    # whole sim horizon ahead (births/exits), far beyond the wheel window
+    net = BatchedNetwork(proto, latency, m, capacity=capacity, wheel_rows=0)
     state = net.init_state(cols, seed=seed, proto=proto.proto_init(m))
 
     # t=0 fully-connected marks (start() -> set_done_at at birth): host-side

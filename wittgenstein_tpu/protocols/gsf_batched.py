@@ -710,7 +710,9 @@ def make_gsf(
     proto.BEAT_RESIDUES = (1 % params.period_duration_ms,)
     city_index = getattr(latency, "city_index", None)
     cols = build_node_columns(nodes, city_index)
-    net = BatchedNetwork(proto, latency, n, capacity=capacity)
+    # flat mode: aggregation messaging bypasses the generic store entirely
+    # (the channel in _agg_batched), so keep the per-tick scan minimal
+    net = BatchedNetwork(proto, latency, n, capacity=capacity, wheel_rows=0)
     state = net.init_state(
         cols,
         seed=seed,

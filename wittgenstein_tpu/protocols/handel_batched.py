@@ -895,6 +895,7 @@ def make_handel(
     params: Optional[HandelParameters] = None,
     capacity: int = 8,  # generic ring unused by this protocol
     seed: int = 0,
+    wheel_rows: int = 0,  # flat by default; >0 = time wheel (parity tests)
 ):
     """Host-side construction: build the node population with the oracle's
     RNG stream (positions, speed ratios, down set), bake into the engine."""
@@ -947,7 +948,12 @@ def make_handel(
 
     city_index = getattr(latency, "city_index", None)
     cols = build_node_columns(nodes, city_index)
-    net = BatchedNetwork(proto, latency, n, capacity=capacity)
+    # flat mode by default: aggregation messaging bypasses the generic
+    # store entirely (the channel in _agg_batched), so keep the per-tick
+    # scan minimal
+    net = BatchedNetwork(
+        proto, latency, n, capacity=capacity, wheel_rows=wheel_rows
+    )
     state = net.init_state(
         cols,
         seed=seed,

@@ -122,7 +122,12 @@ def make_optimistic(
     city_index = getattr(latency, "city_index", None)
     cols = build_node_columns(net_o.all_nodes, city_index)
     proto = BatchedOptimisticP2PSignature(params, adj)
-    net = BatchedNetwork(proto, latency, params.node_count, capacity=capacity)
+    # flat mode: gossip-forward waves are send-synchronized like p2pflood —
+    # a forwarding burst can land on one arrival tick, which would need
+    # wheel rows as wide as the ring
+    net = BatchedNetwork(
+        proto, latency, params.node_count, capacity=capacity, wheel_rows=0
+    )
     state = net.init_state(
         cols, seed=seed, proto=proto.proto_init(params.node_count)
     )

@@ -143,7 +143,12 @@ def make_p2pflood(params: Optional[P2PFloodParameters] = None, capacity: int = 1
     city_index = getattr(latency, "city_index", None)
     cols = build_node_columns(net_o.all_nodes, city_index)
     proto = BatchedP2PFlood(params, adj, senders)
-    net = BatchedNetwork(proto, latency, params.node_count, capacity=capacity)
+    # flat mode: flood waves are send-synchronized (delay_between_sends can
+    # be 0 and latencies fixed), so a whole wave can land on ONE tick —
+    # per-arrival-tick bucketing would need wheel rows as wide as the ring
+    net = BatchedNetwork(
+        proto, latency, params.node_count, capacity=capacity, wheel_rows=0
+    )
     # dead nodes are down from t=0 (P2PFloodNode ctor stop()), before the
     # initial floods go out
     down = np.array([n.is_down() for n in net_o.all_nodes])

@@ -70,10 +70,12 @@ def make_pingpong(
     network_latency_name: Optional[str] = None,
     capacity: Optional[int] = None,
     seed: int = 0,
+    wheel_rows: Optional[int] = None,
 ):
     """Host-side construction mirroring PingPong.init(): build the node
     population with the same JavaRandom stream as the oracle, convert to SoA
-    columns, return (net, state)."""
+    columns, return (net, state).  wheel_rows=0 selects the flat message
+    store (the wheel-parity reference, see docs/engine_timewheel.md)."""
     nb = registry_node_builders.get_by_name(node_builder_name)
     latency = registry_network_latencies.get_by_name(network_latency_name)
     rd = JavaRandom(0)
@@ -84,6 +86,6 @@ def make_pingpong(
     cols = build_node_columns(nodes, city_index)
     proto = BatchedPingPong(node_ct)
     cap = capacity if capacity is not None else 2 * node_ct + 64
-    net = BatchedNetwork(proto, latency, node_ct, capacity=cap)
+    net = BatchedNetwork(proto, latency, node_ct, capacity=cap, wheel_rows=wheel_rows)
     state = net.init_state(cols, seed=seed, proto=proto.proto_init(node_ct))
     return net, state
