@@ -41,6 +41,12 @@ Env knobs:
                                one XLA program per config — no adaptive
                                second compile)
   WITT_BENCH_PROFILE=DIR       capture a jax.profiler trace of the timed run
+  WITT_BENCH_TRACE=FILE        write a Chrome trace-event JSON of the host
+                               phases (compile / timed pass, or the
+                               --phase-profile measurements) via the
+                               telemetry span tracer
+  WITT_BENCH_RUNRECORD=FILE    append the final BENCH record to a JSONL
+                               run-record file (telemetry.RunRecordWriter)
 """
 
 from __future__ import annotations
@@ -298,15 +304,25 @@ def bench_batched(node_ct: int, n_replicas: int, budget_s: float = 1e9) -> dict:
 
     import contextlib
 
+    from wittgenstein_tpu.telemetry import SpanTracer, counters
     from wittgenstein_tpu.tools.profiling import trace
+
+    # host-phase span trace (compile is already gone by the timed pass;
+    # chunks are spanned from the heartbeat timings chunked_pass reports)
+    tracer = SpanTracer(f"bench handel{node_ct}x{n_replicas}")
+    tracer.add_span("compile", 0.0, compile_s * 1e6, nodes=node_ct)
 
     profile_dir = os.environ.get("WITT_BENCH_PROFILE")
     with trace(profile_dir) if profile_dir else contextlib.nullcontext():
         t0 = time.perf_counter()
-        out, chunk_times, ok = run_chunked(_fresh_states(), pass_budget)
+        with tracer.span("timed_pass", replicas=n_replicas):
+            out, chunk_times, ok = run_chunked(_fresh_states(), pass_budget)
         run_s = time.perf_counter() - t0
     if not ok:
         return _partial(chunk_times)
+    trace_path = os.environ.get("WITT_BENCH_TRACE")
+    if trace_path:
+        tracer.write(trace_path)
     return {
         "sims_per_sec": n_replicas / run_s,
         "compile_s": round(compile_s, 1),
@@ -315,10 +331,19 @@ def bench_batched(node_ct: int, n_replicas: int, budget_s: float = 1e9) -> dict:
         # worst single device call — the ladder projects the NEXT rung's
         # chunk time from this before climbing (watchdog safety)
         "max_chunk_s": max(chunk_times) if chunk_times else 0.0,
+        # telemetry counter summary of the measured final state (node +
+        # store tiers; the in-graph tier stays off — the headline must
+        # measure the uninstrumented program)
+        "counters": counters(net, out),
     }
 
 
-def phase_profile(node_ct: int = 256, n_replicas: int = 2, scans: int = 25) -> dict:
+def phase_profile(
+    node_ct: int = 256,
+    n_replicas: int = 2,
+    scans: int = 25,
+    trace_path: "str | None" = None,
+) -> dict:
     """Per-phase tick cost + wheel occupancy high-water marks, reported
     into the BENCH json so future rounds can see where ticks go.
 
@@ -331,44 +356,37 @@ def phase_profile(node_ct: int = 256, n_replicas: int = 2, scans: int = 25) -> d
         with the time wheel its cost tracks the VIEW (window*B + V), not
         the total capacity C, and the two numbers should be ~equal.
     Occupancy high-water (wheel row fill / overflow lane census) comes
-    from the engine's instrumented run (run_ms_occupancy)."""
+    from the engine's instrumented run (run_ms_occupancy).
+
+    The timing loop is the telemetry span-tracer harness
+    (telemetry.phases — shared with scripts/phase_profile.py); pass
+    trace_path to keep the Chrome-trace JSON of the measurement."""
     import jax
-    from jax import lax
 
     from wittgenstein_tpu.engine import replicate_state
     from wittgenstein_tpu.protocols.handel_batched import make_handel
     from wittgenstein_tpu.protocols.pingpong_batched import make_pingpong
+    from wittgenstein_tpu.telemetry import (
+        SpanTracer,
+        engine_phase_fns,
+        scan_phase_seconds,
+    )
 
     _setup_cache()
-
-    def timed(net_states, fn):
-        def body(s, _):
-            return jax.vmap(fn)(s), None
-
-        stepped = jax.jit(lambda s: lax.scan(body, s, None, length=scans)[0])
-        out = stepped(net_states)  # compile + warm
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        jax.block_until_ready(stepped(net_states))
-        return (time.perf_counter() - t0) / scans
+    tracer = SpanTracer("phase-profile")
 
     net, state = make_handel(_params(node_ct))
     states = replicate_state(state, n_replicas)
     states = net.run_ms_batched(states, 120)  # realistic channel occupancy
     jax.block_until_ready(states)
-    proto = net.protocol
-    t_full = timed(states, net.step)
-    t_deliver = timed(states, net._phase_deliver)
-    t_del_apply = timed(states, net._phase_deliver_apply)
-    t_tick = timed(states, lambda s: proto.tick(net, s))
-    t_beat = timed(states, lambda s: proto.tick_beat(net, s))
+    t = scan_phase_seconds(states, engine_phase_fns(net), scans, tracer)
     r3 = lambda x: round(x * 1e3, 3)
     phases = {
-        "full_step_ms": r3(t_full),
-        "delivery_ms": r3(t_deliver),
-        "emission_apply_ms": r3(max(0.0, t_del_apply - t_deliver)),
-        "protocol_tick_ms": r3(t_tick),
-        "beat_ms": r3(t_beat),
+        "full_step_ms": r3(t["full_step"]),
+        "delivery_ms": r3(t["delivery"]),
+        "emission_apply_ms": r3(max(0.0, t["deliver_apply"] - t["delivery"])),
+        "protocol_tick_ms": r3(t["protocol_tick"]),
+        "beat_ms": r3(t["beat"]),
     }
     _, occ = net.run_ms_occupancy(state, 300)
     occupancy = {k: int(v) for k, v in occ.items()}
@@ -379,7 +397,9 @@ def phase_profile(node_ct: int = 256, n_replicas: int = 2, scans: int = 25) -> d
         pnet, pstate = make_pingpong(1000, capacity=(2 * 1000 + 64) * mult)
         pstate = pnet.run_ms(pstate, 150)  # mid-flight in-flight load
         pstates = replicate_state(pstate, n_replicas)
-        dt = timed(pstates, pnet._phase_deliver)
+        dt = scan_phase_seconds(
+            pstates, {"delivery": pnet._phase_deliver}, scans, tracer
+        )["delivery"]
         pn, pocc = pnet.run_ms_occupancy(pstate, 150)
         scaling.append(
             {
@@ -391,6 +411,8 @@ def phase_profile(node_ct: int = 256, n_replicas: int = 2, scans: int = 25) -> d
                 "overflow_hwm": int(pocc["overflow_hwm"]),
             }
         )
+    if trace_path:
+        tracer.write(trace_path)
     return {
         "config": {"node_count": node_ct, "n_replicas": n_replicas, "scans": scans},
         "backend": jax.default_backend(),
@@ -529,6 +551,17 @@ def _headline(
         "probe": probe,
         "bench_error": bench_error,
     }
+
+
+def _emit(rec: dict) -> None:
+    """Print the BENCH record and (optionally) append it to the durable
+    JSONL run-record file."""
+    print(json.dumps(rec))
+    path = os.environ.get("WITT_BENCH_RUNRECORD")
+    if path:
+        from wittgenstein_tpu.telemetry import RunRecordWriter
+
+        RunRecordWriter(path).write(rec, kind="bench")
 
 
 def main() -> None:
@@ -672,24 +705,22 @@ def main() -> None:
             rec["cpu_crosscheck"] = [
                 dict(r, nodes=n, replicas=rr) for n, rr, r in results
             ]
-            print(json.dumps(rec))
+            _emit(rec)
             return
 
     if not results:
-        print(
-            json.dumps(
-                {
-                    "metric": f"{attempted}_sims_per_sec_chip",
-                    "value": 0.0,
-                    "unit": "sims/sec",
-                    "vs_baseline": 0.0,
-                    "platform": platform,
-                    "device_kind": device_kind,
-                    "parity": PARITY_STOP_WHEN_DONE,
-                    "probe": probe,
-                    "bench_error": bench_error,
-                }
-            )
+        _emit(
+            {
+                "metric": f"{attempted}_sims_per_sec_chip",
+                "value": 0.0,
+                "unit": "sims/sec",
+                "vs_baseline": 0.0,
+                "platform": platform,
+                "device_kind": device_kind,
+                "parity": PARITY_STOP_WHEN_DONE,
+                "probe": probe,
+                "bench_error": bench_error,
+            }
         )
         return
 
@@ -716,7 +747,7 @@ def main() -> None:
             rec["phase_profile"] = {
                 "error": f"{type(e).__name__}: {str(e)[:300]}"
             }
-    print(json.dumps(rec))
+    _emit(rec)
 
 
 if __name__ == "__main__":
@@ -735,6 +766,14 @@ if __name__ == "__main__":
             jax.config.update("jax_platforms", "cpu")
         node_ct = int(sys.argv[2]) if len(sys.argv) > 2 else 256
         n_replicas = int(sys.argv[3]) if len(sys.argv) > 3 else 2
-        print(json.dumps(phase_profile(node_ct, n_replicas)))
+        print(
+            json.dumps(
+                phase_profile(
+                    node_ct,
+                    n_replicas,
+                    trace_path=os.environ.get("WITT_BENCH_TRACE"),
+                )
+            )
+        )
     else:
         main()

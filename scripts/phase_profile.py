@@ -10,6 +10,11 @@ JAX_PLATFORMS=axon in the environment, so the env var can't express
 profile on the session's device platform.  The backend actually used is
 printed in the table header.
 
+The timing loop is the telemetry span-tracer harness
+(wittgenstein_tpu.telemetry.phases — the same one behind bench.py's
+--phase-profile); WITT_PROFILE_TRACE=FILE keeps the Chrome trace-event
+JSON of the measurement phases.
+
 Usage: python scripts/phase_profile.py [nodes] [replicas]
 """
 
@@ -17,7 +22,6 @@ from __future__ import annotations
 
 import os
 import sys
-import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
@@ -26,8 +30,6 @@ _on_device = os.environ.get("WITT_PROFILE_DEVICE") == "1"
 if not _on_device:
     os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-from jax import lax  # noqa: E402
 
 if not _on_device:
     # the environment's sitecustomize pins jax_platforms=axon at the
@@ -37,6 +39,7 @@ if not _on_device:
 import bench as benchmod  # noqa: E402
 from wittgenstein_tpu.engine import replicate_state  # noqa: E402
 from wittgenstein_tpu.protocols.handel_batched import make_handel  # noqa: E402
+from wittgenstein_tpu.telemetry import SpanTracer, scan_phase_seconds  # noqa: E402
 
 
 def main() -> None:
@@ -51,32 +54,25 @@ def main() -> None:
     jax.block_until_ready(states)
 
     proto = net.protocol
-
-    def scan_phase(name, fn):
-        def body(s, _):
-            return jax.vmap(fn)(s), None
-
-        stepped = jax.jit(lambda s: lax.scan(body, s, None, length=scans)[0])
-        out = stepped(states)  # compile + warm
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        out = stepped(states)
-        jax.block_until_ready(out)
-        dt = (time.perf_counter() - t0) / scans
-        return name, dt
-
-    rows = [
-        scan_phase("full step", lambda s: net.step(s)),
-        scan_phase("channel_deliver", lambda s: proto._channel_deliver(net, s)),
-        scan_phase("commit", lambda s: proto._commit(net, s)),
-        scan_phase("dissemination", lambda s: proto._dissemination(net, s)),
-        scan_phase("select", lambda s: proto._select(net, s)),
-    ]
-    full = rows[0][1]
+    tracer = SpanTracer(f"phase-profile handel{nodes}x{replicas}")
+    # handel-internal phases (this script's table) on the SHARED timing
+    # loop — bench --phase-profile times the engine-generic set instead
+    phases = {
+        "full step": lambda s: net.step(s),
+        "channel_deliver": lambda s: proto._channel_deliver(net, s),
+        "commit": lambda s: proto._commit(net, s),
+        "dissemination": lambda s: proto._dissemination(net, s),
+        "select": lambda s: proto._select(net, s),
+    }
+    t = scan_phase_seconds(states, phases, scans, tracer)
+    full = t["full step"]
     print(f"\nHandel {nodes}x{replicas}, scan x{scans}, backend={jax.default_backend()}")
     print(f"{'phase':<18} {'ms/iter':>8} {'share':>6}")
-    for name, dt in rows:
-        print(f"{name:<18} {dt*1e3:>8.1f} {dt/full*100:>5.0f}%")
+    for name in phases:
+        print(f"{name:<18} {t[name]*1e3:>8.1f} {t[name]/full*100:>5.0f}%")
+    trace_path = os.environ.get("WITT_PROFILE_TRACE")
+    if trace_path:
+        print(f"trace -> {tracer.write(trace_path)}")
 
 
 if __name__ == "__main__":

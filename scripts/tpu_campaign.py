@@ -204,6 +204,8 @@ def campaign() -> None:
                  "chunk_times": chunk_times,
                  "reason": "timed pass exceeded budget (worker degraded?)"})
             break
+        from wittgenstein_tpu.telemetry import counters
+
         rec = {
             "event": "rung", "nodes": NODES, "replicas": r,
             "chunk_ms": CHUNK_MS, "warm_s": round(warm_s, 1),
@@ -213,6 +215,10 @@ def campaign() -> None:
             "all_done": ok_done,
             "chunk_times": chunk_times,
             "displaced": int(out.proto["displaced"].sum()),
+            # telemetry counter summary of the measured final state (the
+            # MULTICHIP-record payload; in-graph tier off — the rung
+            # must measure the uninstrumented program)
+            "counters": counters(net, out),
         }
         log(rec)
         results.append(rec)

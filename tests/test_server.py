@@ -66,8 +66,10 @@ class TestWServer:
             assert status == 200, p
             assert len(nodes) > 0, p
 
-            status, _ = get(base_url, "/w/network/messages")
+            status, out = get(base_url, "/w/network/messages")
             assert status == 200, p
+            assert isinstance(out["messages"], list), p
+            assert "occupancy" in out and "dropped" in out, p
 
     def test_run_and_inspect_flow(self, base_url):
         _, params = get(base_url, "/w/protocols/PingPong")
@@ -104,7 +106,11 @@ class TestWServer:
             },
         )
         assert status == 200
-        _, msgs = get(base_url, "/w/network/messages")
+        _, out = get(base_url, "/w/network/messages")
+        msgs = out["messages"]
+        # one envelope may fan out to several EnvelopeInfos, so the
+        # census bounds are envelope-count <= info-count
+        assert 1 <= out["occupancy"]["pending_msgs"] <= len(msgs)
         assert any(m["msg"] == "Ping" and m["from"] == 3 for m in msgs)
         # deliver them: receivers answer with pongs
         post(base_url, "/w/network/runMs/1000")
@@ -168,6 +174,105 @@ class TestWServer:
         assert post(base_url, "/w/network/runMs/400")[0] == 200
         _, n0 = get(base_url, "/w/network/nodes/0")
         assert n0["msgReceived"] > 0
+
+
+def parse_prometheus(text):
+    """Minimal text-format parser: {metric_name: [(labels_dict, value)]}.
+    Raises on malformed sample lines — the test doubles as a format
+    check."""
+    import re as _re
+
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (\S+)$", line)
+        assert m, f"malformed sample line: {line!r}"
+        labels = {}
+        if m.group(2):
+            for part in m.group(2)[1:-1].split(","):
+                if part:
+                    k, v = part.split("=", 1)
+                    labels[k] = v.strip('"')
+        out.setdefault(m.group(1), []).append((labels, float(m.group(3))))
+    return out
+
+
+class TestTelemetryEndpoints:
+    def test_metrics_before_init(self, base_url):
+        """/metrics answers even on a fresh server (scrapers attach
+        before the first init)."""
+        import urllib.request as _rq
+
+        with _rq.urlopen(base_url + "/metrics", timeout=60) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        metrics = parse_prometheus(text)
+        assert metrics["witt_server_up"][0][1] == 1
+
+    def test_metrics_live_sim(self, base_url):
+        """GET /metrics returns Prometheus text with engine counters for
+        a live simulation (the PR's acceptance criterion)."""
+        import urllib.request as _rq
+
+        _, params = get(base_url, "/w/protocols/PingPong")
+        params["node_ct"] = 60
+        assert post(base_url, "/w/network/init/PingPong", params)[0] == 200
+        assert post(base_url, "/w/network/runMs/150")[0] == 200
+
+        with _rq.urlopen(base_url + "/metrics", timeout=60) as r:
+            assert r.status == 200
+            text = r.read().decode()
+        metrics = parse_prometheus(text)
+        for name in (
+            "witt_sim_time_ms",
+            "witt_nodes",
+            "witt_live_nodes",
+            "witt_node_msg_sent_total",
+            "witt_node_msg_received_total",
+            "witt_messages_dropped_total",
+            "witt_store_pending",
+        ):
+            assert name in metrics, f"{name} missing from /metrics"
+        assert metrics["witt_sim_time_ms"][0][1] == 150
+        assert metrics["witt_nodes"][0][1] == 60
+        assert metrics["witt_node_msg_received_total"][0][1] > 0
+
+    def test_status_endpoint(self, base_url):
+        _, params = get(base_url, "/w/protocols/PingPong")
+        params["node_ct"] = 40
+        post(base_url, "/w/network/init/PingPong", params)
+        status, out = post(base_url, "/w/network/runMs/100")
+        assert status == 200
+        assert "occupancy" in out and "dropped" in out  # runMs status payload
+        status, st = get(base_url, "/w/network/status")
+        assert status == 200
+        assert st["nodeCount"] == 40 and st["time"] == 100
+        assert st["msgSent"] >= st["msgReceived"] > 0
+        assert st["occupancy"]["pending_msgs"] >= 0
+        assert st["dropped"] == 0
+
+    def test_status_dropped_counts_down_sends(self, base_url):
+        """Sends to a stopped node are filtered at send time and show up
+        in the dropped counter (oracle twin of SimState.dropped)."""
+        _, params = get(base_url, "/w/protocols/PingPong")
+        params["node_ct"] = 30
+        post(base_url, "/w/network/init/PingPong", params)
+        post(base_url, "/w/network/nodes/7/stop")
+        post(
+            base_url,
+            "/w/network/send",
+            {
+                "from": 3,
+                "to": [7],
+                "sendTime": 1,
+                "delayBetweenSend": 0,
+                "message": {"type": "Ping"},
+            },
+        )
+        _, st = get(base_url, "/w/network/status")
+        assert st["dropped"] >= 1
 
 
 class TestStaticUI:

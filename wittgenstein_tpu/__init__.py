@@ -18,6 +18,9 @@ Layout:
   parallel/   device mesh / sharding of the replica and node axes
   runner/     multi-run & progress-per-time drivers, sweeps
   stats/      StatsHelper-equivalent reductions
+  telemetry/  in-graph counters + progress snapshot ring (device-side),
+              Prometheus / JSONL run-record / Chrome-trace exporters,
+              shared phase-profiling harness (docs/telemetry.md)
   tools/      plots, CSV, latency-matrix baking, node drawing
   server/     REST control server (stdlib http)
   utils/      JavaRandom, Pareto distribution, bitset & math helpers

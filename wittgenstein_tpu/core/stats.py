@@ -134,3 +134,82 @@ class CounterStatsGetter(StatsGetter):
 
     def get(self, live_nodes) -> Stat:
         return Counter(sum(1 for n in live_nodes if self._pred(n)))
+
+
+# -- batched-engine adapters -------------------------------------------------
+# The same Stat/StatsGetter shape over SoA columns and telemetry counters:
+# sweep drivers and the /w/sweep endpoint reduce batched outputs with the
+# identical field contract (min/max/avg, Java long division) the host-side
+# getters expose, so downstream consumers never see two schemas.
+
+
+def get_stats_on_array(values) -> SimpleStats:
+    """min/max/avg of a value array (any shape), Java long division —
+    the vectorized twin of get_stats_on."""
+    import numpy as np
+
+    v = np.asarray(values, dtype=np.int64).reshape(-1)
+    if v.size == 0:
+        raise ValueError("no values")
+    tot = int(v.sum())
+    a = tot // v.size if tot >= 0 else -((-tot) // v.size)
+    return SimpleStats(int(v.min()), int(v.max()), a)
+
+
+class BatchedStatsGetter(StatsGetter):
+    """SimpleStats over a SimState node column, reduced across every
+    (replica, node) pair with the node live.  `get` accepts either a
+    batched SimState (leading replica axes collapse) or a plain array."""
+
+    def __init__(self, column: str):
+        self.column = column
+
+    def fields(self) -> List[str]:
+        return ["min", "max", "avg"]
+
+    def get(self, state_or_values) -> Stat:
+        import numpy as np
+
+        if hasattr(state_or_values, self.column):
+            state = state_or_values
+            vals = np.asarray(getattr(state, self.column))
+            live = ~np.asarray(state.down)
+            return get_stats_on_array(vals[live])
+        return get_stats_on_array(state_or_values)
+
+
+class DoneAtBatchedStatGetter(BatchedStatsGetter):
+    def __init__(self):
+        super().__init__("done_at")
+
+
+class MsgReceivedBatchedStatGetter(BatchedStatsGetter):
+    def __init__(self):
+        super().__init__("msg_received")
+
+
+class TelemetryCounterStatGetter(StatsGetter):
+    """Counter over an in-graph telemetry field (telemetry.TelemetryState
+    on a state's `tele` side-car), summed over replicas and — unless a
+    specific mtype index is given — over message types."""
+
+    def __init__(self, field: str, mtype: "int | None" = None):
+        self.field = field
+        self.mtype = mtype
+
+    def fields(self) -> List[str]:
+        return ["count"]
+
+    def get(self, state) -> Stat:
+        import numpy as np
+
+        tele = state.tele if hasattr(state, "tele") else state
+        if tele == ():
+            raise ValueError(
+                "state has no telemetry side-car — build the engine with "
+                "telemetry=TelemetryConfig(...)"
+            )
+        a = np.asarray(getattr(tele, self.field))
+        if self.mtype is not None:
+            a = a[..., self.mtype]
+        return Counter(int(a.sum()))

@@ -51,6 +51,12 @@ from jax import lax
 
 from ..core.latency import LatencyStatic, NetworkLatency, vec_latency
 from ..ops.bitops import lowest_set_bit, pack_bool_words, popcount_words
+from ..telemetry.state import (
+    TelemetryConfig,
+    count_by_type,
+    init_telemetry,
+    record_snapshot,
+)
 from .rng import hash32, pseudo_delta
 
 MAX_PARTITIONS = 4
@@ -106,6 +112,11 @@ class SimState(NamedTuple):
     msg_head: jnp.ndarray  # int32 scalar: monotone sent-message counter
     dropped: jnp.ndarray  # int32 scalar: wheel+overflow overflow count
     proto: Any  # protocol-defined pytree
+    # telemetry side-car: () when the engine's TelemetryConfig is unset
+    # (zero pytree leaves, zero traced ops), a telemetry.TelemetryState
+    # of pure counters otherwise — never read by sim dynamics, so an
+    # instrumented run is bit-identical in every other field
+    tele: Any = ()
 
 
 @dataclasses.dataclass
@@ -155,6 +166,7 @@ class BatchedNetwork:
         wheel_rows: Optional[int] = None,
         wheel_slots: Optional[int] = None,
         overflow_capacity: Optional[int] = None,
+        telemetry: Optional[TelemetryConfig] = None,
     ):
         self.protocol = protocol
         self.latency = latency
@@ -162,6 +174,10 @@ class BatchedNetwork:
         self.capacity = capacity
         self.msg_discard_time = msg_discard_time
         self.throughput = throughput
+        # STATIC switch: None compiles the exact pre-telemetry program
+        # (state.tele is an empty pytree); a TelemetryConfig threads the
+        # counter side-car through every send/deliver/jump site below
+        self.telemetry = telemetry
         self.payload_width = protocol.PAYLOAD_WIDTH
         sizes = [protocol.msg_size(t) for t in range(protocol.n_msg_types())]
         self._msg_sizes = np.asarray(sizes, dtype=np.int32)
@@ -243,6 +259,11 @@ class BatchedNetwork:
             msg_head=jnp.int32(0),
             dropped=jnp.int32(0),
             proto=proto,
+            tele=(
+                init_telemetry(self.telemetry, self.protocol.n_msg_types())
+                if self.telemetry is not None
+                else ()
+            ),
         )
         for em in self.protocol.initial_emissions(self, state):
             state = self.apply_emission(state, em)
@@ -270,7 +291,43 @@ class BatchedNetwork:
             type(self.throughput).__name__ if self.throughput else None,
             getattr(self, "node_axis", None),
             id(mesh) if mesh is not None else None,
+            self.telemetry.key() if self.telemetry is not None else None,
         )
+
+    def with_telemetry(
+        self, state: SimState, telemetry: TelemetryConfig
+    ) -> "tuple[BatchedNetwork, SimState]":
+        """Instrument an ALREADY-BUILT simulation: returns an engine copy
+        carrying the TelemetryConfig (fresh jit identity, like
+        enable_node_sharding's copy) and the state with a counter
+        side-car attached.  The side-car's per-mtype `sent` is seeded
+        with the current store census, so the store invariant
+        (sent == delivered + discarded + dropped + pending) holds from
+        the first tick even when initial emissions predate
+        instrumentation.  Works on single and batched states (leading
+        axes broadcast)."""
+        import copy
+
+        net = copy.copy(self)
+        net.telemetry = telemetry
+        t = self.protocol.n_msg_types()
+        tele = init_telemetry(telemetry, t)
+        lead = tuple(jnp.shape(state.time))
+        if lead:
+            tele = jax.tree_util.tree_map(
+                lambda a: jnp.broadcast_to(a, lead + a.shape), tele
+            )
+        # store census per mtype via one-hot (T is small): [..., W, B, T]
+        # and [..., V, T] reduced over the store axes
+        t_arr = jnp.arange(t, dtype=jnp.int32)
+        in_wheel = (
+            (state.msg_type[..., None] == t_arr) & state.msg_valid[..., None]
+        ).sum((-3, -2))
+        in_ovf = (
+            (state.ovf_type[..., None] == t_arr) & state.ovf_valid[..., None]
+        ).sum(-2)
+        tele = tele._replace(sent=(in_wheel + in_ovf).astype(jnp.int32))
+        return net, state._replace(tele=tele)
 
     # -- partitions (Network.partition, Network.java:693-707) ----------------
     @staticmethod
@@ -338,6 +395,20 @@ class BatchedNetwork:
             & (pid_f == pid_t)
             & (lat < self.msg_discard_time)
         )
+        if self.telemetry is not None:
+            # the latency kernel is the one choke point EVERY send crosses
+            # (generic store and the agg protocols' channel commits alike),
+            # so per-mtype traffic is counted here, not in apply_emission
+            mrows = jnp.broadcast_to(mtype, mask.shape).astype(jnp.int32)
+            tele = state.tele
+            state = state._replace(
+                tele=tele._replace(
+                    lat_sent=count_by_type(tele.lat_sent, ok, mrows),
+                    lat_filtered=count_by_type(
+                        tele.lat_filtered, mask & ~ok, mrows
+                    ),
+                )
+            )
         return state, ok, arrival
 
     def apply_emission(self, state: SimState, em: Emission) -> SimState:
@@ -452,6 +523,27 @@ class BatchedNetwork:
             state = state._replace(
                 ovf_payload=state.ovf_payload.at[pos].set(payload, mode="drop")
             )
+        if self.telemetry is not None:
+            # store accounting: every ok row is either inserted (wheel or
+            # overflow) or dropped (to_ovf & ~ofits — the rows behind the
+            # scalar `overwritten` above), so sent - dropped rows are live.
+            # HWMs sample post-insert, the only moment occupancy can peak.
+            tele = state.tele
+            state = state._replace(
+                tele=tele._replace(
+                    sent=count_by_type(tele.sent, ok, mtype_rows),
+                    dropped=count_by_type(
+                        tele.dropped, to_ovf & ~ofits, mtype_rows
+                    ),
+                    wheel_fill_hwm=jnp.maximum(
+                        tele.wheel_fill_hwm, jnp.max(state.whl_fill)
+                    ),
+                    ovf_hwm=jnp.maximum(
+                        tele.ovf_hwm,
+                        jnp.sum(state.ovf_valid.astype(jnp.int32)),
+                    ),
+                )
+            )
         return state
 
     def apply_emissions(self, state: SimState, emissions) -> SimState:
@@ -518,6 +610,19 @@ class BatchedNetwork:
                 dm * sizes, mode="drop"
             ),
         )
+        if self.telemetry is not None:
+            # due rows leave the store exactly once, as delivered or as
+            # delivery-time discards (down dest / cross-partition) — the
+            # split the store invariant needs
+            tele = state.tele
+            state = state._replace(
+                tele=tele._replace(
+                    delivered=count_by_type(tele.delivered, deliver, view_type),
+                    discarded=count_by_type(
+                        tele.discarded, due & ~deliver, view_type
+                    ),
+                )
+            )
 
         # hand the protocol a view-state whose msg_* columns are the flat
         # [D] gathers; protocols must not touch msg_* (the engine owns the
@@ -584,10 +689,22 @@ class BatchedNetwork:
         state, emissions = self._deliver_and_clear(state)
         return self.apply_emissions(state, emissions)
 
+    def _tele_tick(self, state: SimState) -> SimState:
+        """Per-executed-tick telemetry: tick census + (optionally) the
+        progress-snapshot write, keyed by the tick just executed (called
+        BEFORE the time advance, from both run paths)."""
+        if self.telemetry is None:
+            return state
+        tele = state.tele._replace(ticks=state.tele.ticks + 1)
+        if self.telemetry.snapshots:
+            tele = record_snapshot(tele, self.telemetry, state)
+        return state._replace(tele=tele)
+
     def step(self, state: SimState) -> SimState:
         state = self._step_core(state)
         state = self.protocol.tick_beat(self, state)
         state = self.protocol.tick_post(self, state)
+        state = self._tele_tick(state)
         return state._replace(time=state.time + 1)
 
     # -- occupancy summaries --------------------------------------------------
@@ -651,6 +768,15 @@ class BatchedNetwork:
                 t = jnp.minimum(
                     (t + q - 1) // q * q, jnp.asarray(end, jnp.int32)
                 ).astype(jnp.int32)
+            if self.telemetry is not None:
+                tele = state.tele
+                state = state._replace(
+                    tele=tele._replace(
+                        jumps=tele.jumps
+                        + (t > state.time).astype(jnp.int32),
+                        jumped_ms=tele.jumped_ms + (t - state.time),
+                    )
+                )
             state = state._replace(time=t)
         return state
 
@@ -746,6 +872,8 @@ class BatchedNetwork:
             s = step_v(s)
             s = lax.cond(is_beat, beat_v, skip_beat, s)
             s = post_v(s)
+            if self.telemetry is not None:
+                s = jax.vmap(self._tele_tick)(s)
             return s._replace(time=s.time + 1)
 
         if not stop_when_done:

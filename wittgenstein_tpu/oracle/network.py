@@ -313,6 +313,11 @@ class Network(Generic[TN]):
         self.network_latency: NetworkLatency = IC3NetworkLatency()
         self.network_throughput = None  # optional Mathis model (opt-in)
         self.time = 0
+        # observability (telemetry parity with the batched engine's
+        # SimState.dropped / occupancy()): sends filtered at send time —
+        # down endpoint, cross-partition, discard-time (the reference
+        # drops these silently at Network.java:476-487)
+        self.dropped = 0
 
     # -- helpers -----------------------------------------------------------
     @staticmethod
@@ -346,6 +351,15 @@ class Network(Generic[TN]):
 
     def has_message(self) -> bool:
         return self.msgs.size() != 0
+
+    def occupancy(self) -> dict:
+        """Store census, shape-compatible with the batched engine's
+        occupancy() (wserver surfaces both through the same endpoints)."""
+        return {
+            "pending_msgs": self.msgs.size(),
+            "pending_buckets": len(self.msgs._buckets),
+            "conditional_tasks": len(self.conditional_tasks),
+        }
 
     # -- time --------------------------------------------------------------
     def run(self, seconds: int) -> bool:
@@ -452,6 +466,7 @@ class Network(Generic[TN]):
             )
             if nt < self.msg_discard_time:
                 return (to_node, send_time + nt)
+        self.dropped += 1
         return None
 
     # -- tasks -------------------------------------------------------------

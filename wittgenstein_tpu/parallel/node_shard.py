@@ -102,6 +102,9 @@ _MESSAGE_STORE_FIELDS = (
     ".msg_valid", ".msg_arrival", ".msg_from", ".msg_to", ".msg_type",
     ".msg_payload", ".whl_fill", ".ovf_valid", ".ovf_arrival", ".ovf_from",
     ".ovf_to", ".ovf_type", ".ovf_payload",
+    # telemetry side-car: counter rows are mtype-/window-indexed, never
+    # node-indexed — replicate even if a dimension coincides with n_nodes
+    ".tele",
 )
 
 

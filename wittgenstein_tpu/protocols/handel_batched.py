@@ -896,6 +896,7 @@ def make_handel(
     capacity: int = 8,  # generic ring unused by this protocol
     seed: int = 0,
     wheel_rows: int = 0,  # flat by default; >0 = time wheel (parity tests)
+    telemetry=None,  # telemetry.TelemetryConfig (None = uninstrumented)
 ):
     """Host-side construction: build the node population with the oracle's
     RNG stream (positions, speed ratios, down set), bake into the engine."""
@@ -952,7 +953,8 @@ def make_handel(
     # store entirely (the channel in _agg_batched), so keep the per-tick
     # scan minimal
     net = BatchedNetwork(
-        proto, latency, n, capacity=capacity, wheel_rows=wheel_rows
+        proto, latency, n, capacity=capacity, wheel_rows=wheel_rows,
+        telemetry=telemetry,
     )
     state = net.init_state(
         cols,

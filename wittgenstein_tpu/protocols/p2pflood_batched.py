@@ -127,7 +127,12 @@ class BatchedP2PFlood(BatchedProtocol):
         return jnp.all(jnp.where(live, state.done_at > 0, True))
 
 
-def make_p2pflood(params: Optional[P2PFloodParameters] = None, capacity: int = 1 << 13, seed: int = 0):
+def make_p2pflood(
+    params: Optional[P2PFloodParameters] = None,
+    capacity: int = 1 << 13,
+    seed: int = 0,
+    telemetry=None,
+):
     """Host-side construction: run the oracle init() for the graph + sender
     choice (same RNG stream), then bake into the batched engine."""
     params = params or P2PFloodParameters()
@@ -147,7 +152,8 @@ def make_p2pflood(params: Optional[P2PFloodParameters] = None, capacity: int = 1
     # be 0 and latencies fixed), so a whole wave can land on ONE tick —
     # per-arrival-tick bucketing would need wheel rows as wide as the ring
     net = BatchedNetwork(
-        proto, latency, params.node_count, capacity=capacity, wheel_rows=0
+        proto, latency, params.node_count, capacity=capacity, wheel_rows=0,
+        telemetry=telemetry,
     )
     # dead nodes are down from t=0 (P2PFloodNode ctor stop()), before the
     # initial floods go out

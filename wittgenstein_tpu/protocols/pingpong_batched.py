@@ -71,11 +71,13 @@ def make_pingpong(
     capacity: Optional[int] = None,
     seed: int = 0,
     wheel_rows: Optional[int] = None,
+    telemetry=None,
 ):
     """Host-side construction mirroring PingPong.init(): build the node
     population with the same JavaRandom stream as the oracle, convert to SoA
     columns, return (net, state).  wheel_rows=0 selects the flat message
-    store (the wheel-parity reference, see docs/engine_timewheel.md)."""
+    store (the wheel-parity reference, see docs/engine_timewheel.md);
+    telemetry takes a telemetry.TelemetryConfig (None = uninstrumented)."""
     nb = registry_node_builders.get_by_name(node_builder_name)
     latency = registry_network_latencies.get_by_name(network_latency_name)
     rd = JavaRandom(0)
@@ -86,6 +88,9 @@ def make_pingpong(
     cols = build_node_columns(nodes, city_index)
     proto = BatchedPingPong(node_ct)
     cap = capacity if capacity is not None else 2 * node_ct + 64
-    net = BatchedNetwork(proto, latency, node_ct, capacity=cap, wheel_rows=wheel_rows)
+    net = BatchedNetwork(
+        proto, latency, node_ct, capacity=cap, wheel_rows=wheel_rows,
+        telemetry=telemetry,
+    )
     state = net.init_state(cols, seed=seed, proto=proto.proto_init(node_ct))
     return net, state

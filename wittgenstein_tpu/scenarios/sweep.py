@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
+from ..core import stats as SH
 from ..engine import stack_states
 from ..protocols.handel import HandelParameters
 from ..protocols.handel_batched import make_handel
@@ -78,12 +79,25 @@ def _group_key(p: HandelParameters):
     )
 
 
+def _host_done_cdf(done_cols: np.ndarray, sim_ms: int, every: int) -> dict:
+    """Done-node counts at each window end, computed host-side from the
+    final done_at columns ([R, N]) — the classic post-hoc reconstruction
+    of the time-to-aggregation CDF."""
+    qts = list(range(every - 1, sim_ms, every))
+    counts = [
+        [int(((dc > 0) & (dc <= t)).sum()) for t in qts] for dc in done_cols
+    ]
+    return {"times": qts, "counts": counts}
+
+
 def run_sweep(
     configs: List[SweepConfig],
     replicas: int = 4,
     sim_ms: int = 3000,
     seed0: int = 0,
     stop_when_done: bool = False,
+    telemetry=None,
+    telemetry_out: Optional[list] = None,
 ) -> List[BasicStats]:
     """Run every (config x replica) in stacked batches; one BasicStats per
     config, reduced over live nodes of all its replicas.
@@ -91,8 +105,17 @@ def run_sweep(
     stop_when_done skips ticks once EVERY stacked row's aggregation
     completed (engine early exit) — doneAt stats are unchanged, but the
     msgRcv/msgFiltered counters stop at completion, so leave it off when
-    comparing traffic against the oracle."""
+    comparing traffic against the oracle.
+
+    telemetry takes a telemetry.TelemetryConfig: the sweep then runs
+    instrumented (bit-identical sim state, counter side-car on device)
+    and, when `telemetry_out` is a list, appends one record per config —
+    StatsGetter-shaped doneAt/msgReceived reductions, per-mtype traffic
+    counters, and the per-replica progress series decoded from the
+    on-device snapshot ring (the done-at CDF without per-window host
+    reads)."""
     results: Dict[int, BasicStats] = {}
+    tele_records: Dict[int, dict] = {}
 
     # group by traced-program shape so each group is ONE compiled sweep
     groups: Dict[tuple, List[int]] = {}
@@ -103,7 +126,7 @@ def run_sweep(
         states, net = [], None
         for i in idxs:
             # one net serves the whole group (identical traced programs)
-            group_net, st = make_handel(configs[i].params)
+            group_net, st = make_handel(configs[i].params, telemetry=telemetry)
             net = net or group_net
             for r in range(replicas):
                 states.append(
@@ -132,7 +155,45 @@ def run_sweep(
                 int(filt[sl][live].mean()),
                 int(checked[sl][live].mean()),
             )
+            if telemetry is not None and telemetry_out is not None:
+                sub = jax.tree_util.tree_map(lambda a: a[sl], out)
+                fields = ("min", "max", "avg")
+                cnt = lambda f: SH.TelemetryCounterStatGetter(f).get(sub).get(
+                    "count"
+                )
+                from ..telemetry import progress_series
 
+                tele_records[i] = {
+                    "label": configs[i].label,
+                    "value": configs[i].value,
+                    # StatsGetter-shaped reductions (same field contract
+                    # as the host-side DoneAt/MsgReceived getters)
+                    "doneAt": {
+                        f: SH.DoneAtBatchedStatGetter().get(sub).get(f)
+                        for f in fields
+                    },
+                    "msgReceived": {
+                        f: SH.MsgReceivedBatchedStatGetter().get(sub).get(f)
+                        for f in fields
+                    },
+                    # per-run traffic counters (telemetry side-car sums)
+                    "msgSentTotal": cnt("lat_sent"),
+                    "msgFilteredTotal": cnt("lat_filtered"),
+                    "storeDropped": cnt("dropped"),
+                    "ticks": cnt("ticks"),
+                    # one progress series per replica row of this config
+                    "progress": progress_series(sub),
+                    # host-side done-at CDF from the final state (the
+                    # post-hoc path the snapshot ring replaces; kept in
+                    # the record so the two can be diffed — the parity
+                    # test pins them equal)
+                    "doneAtCdfHost": _host_done_cdf(
+                        done[sl], sim_ms, telemetry.snapshot_every_ms
+                    ),
+                }
+
+    if telemetry is not None and telemetry_out is not None:
+        telemetry_out.extend(tele_records[i] for i in range(len(configs)))
     return [results[i] for i in range(len(configs))]
 
 

@@ -140,6 +140,62 @@ class Server:
         # msgs.peekMessages (Network.java:279-287 via WServer.java:67-70)
         return [ei.to_dict() for ei in self.protocol.network().msgs.peek_messages()]
 
+    def get_status(self) -> dict:
+        """Live-simulation counter summary (the telemetry tier the
+        reference never had): aggregate node counters + the network's
+        occupancy census and send-time drop count."""
+        net = self.protocol.network()
+        nodes = net.all_nodes
+        return {
+            "protocol": type(self._protocol).__name__,
+            "time": net.time,
+            "nodeCount": len(nodes),
+            "liveNodes": sum(1 for n in nodes if not n.is_down()),
+            "doneNodes": sum(1 for n in nodes if n.done_at > 0),
+            "msgReceived": sum(n.msg_received for n in nodes),
+            "msgSent": sum(n.msg_sent for n in nodes),
+            "bytesReceived": sum(n.bytes_received for n in nodes),
+            "bytesSent": sum(n.bytes_sent for n in nodes),
+            "occupancy": net.occupancy(),
+            "dropped": net.dropped,
+        }
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the live sim (GET /metrics).
+        Always renders — an uninitialized server reports only its own
+        up-ness, so a scraper can attach before the first init."""
+        from ..telemetry.export import PromText
+
+        p = PromText("witt")
+        p.add("server_up", 1, "wittgenstein-tpu control server alive")
+        if self._protocol is None:
+            return p.render()
+        s = self.get_status()
+        p.add("sim_time_ms", s["time"], "simulated time, ms")
+        p.add("nodes", s["nodeCount"], "total nodes")
+        p.add("live_nodes", s["liveNodes"], "nodes not down")
+        p.add("done_nodes", s["doneNodes"], "nodes with doneAt > 0")
+        p.add("node_msg_sent_total", s["msgSent"], "node msgSent sum", "counter")
+        p.add(
+            "node_msg_received_total",
+            s["msgReceived"],
+            "node msgReceived sum",
+            "counter",
+        )
+        p.add("node_bytes_sent_total", s["bytesSent"], "", "counter")
+        p.add("node_bytes_received_total", s["bytesReceived"], "", "counter")
+        p.add(
+            "messages_dropped_total",
+            s["dropped"],
+            "sends filtered at send time (down/partition/discard)",
+            "counter",
+        )
+        occ = s["occupancy"]
+        p.add("store_pending", occ["pending_msgs"], "in-flight messages")
+        p.add("store_pending_buckets", occ["pending_buckets"], "occupied ms buckets")
+        p.add("conditional_tasks", occ["conditional_tasks"], "registered conditional tasks")
+        return p.render()
+
     # -- control -------------------------------------------------------------
     def start_node(self, node_id: int) -> None:
         self.protocol.network().get_node_by_id(node_id).start()

@@ -10,6 +10,8 @@ including its start/stop asymmetry — /w/nodes/{id}/start vs
   POST /w/network/init/{name}            init from parameters JSON body
   POST /w/network/runMs/{ms}             advance the simulation
   GET  /w/network/time                   current sim time (ms)
+  GET  /w/network/status                 counter summary + occupancy/dropped
+  GET  /metrics                          Prometheus text exposition
   GET  /w/network/nodes                  all node views
   GET  /w/network/nodes/{id}             one node view
   GET  /w/network/messages               in-flight message views
@@ -40,6 +42,15 @@ from .server import Server
 _STATIC_DIR = Path(__file__).parent / "static"
 
 _ROUTES = []
+
+
+class RawResponse:
+    """A handler result served verbatim instead of json-encoded (the
+    /metrics endpoint speaks Prometheus text exposition)."""
+
+    def __init__(self, body: str, content_type: str = "text/plain; charset=utf-8"):
+        self.body = body
+        self.content_type = content_type
 
 
 def route(method: str, pattern: str, locked: bool = True):
@@ -80,11 +91,28 @@ class WServer:
     @route("POST", r"/w/network/runMs/(?P<ms>\d+)")
     def run_ms(self, body, ms):
         self.server.run_ms(int(ms))
-        return {"ok": True, "time": self.server.get_time()}
+        net = self.server.protocol.network()
+        return {
+            "ok": True,
+            "time": self.server.get_time(),
+            # status payload telemetry: callers polling runMs see store
+            # pressure and send-time drops without a second request
+            "occupancy": net.occupancy(),
+            "dropped": net.dropped,
+        }
 
     @route("GET", r"/w/network/time")
     def get_time(self, body):
         return self.server.get_time()
+
+    @route("GET", r"/w/network/status")
+    def status(self, body):
+        return self.server.get_status()
+
+    @route("GET", r"/metrics")
+    def metrics(self, body):
+        # Prometheus convention: bare /metrics, text format, no /w prefix
+        return RawResponse(self.server.metrics_text())
 
     @route("GET", r"/w/network/nodes")
     def nodes(self, body):
@@ -96,7 +124,15 @@ class WServer:
 
     @route("GET", r"/w/network/messages")
     def messages(self, body):
-        return self.server.get_messages()
+        # the reference returns the bare EnvelopeInfo list; the wrapper
+        # adds the engine occupancy census + dropped counter alongside
+        # (same upgrade as the runMs status payload)
+        net = self.server.protocol.network()
+        return {
+            "messages": self.server.get_messages(),
+            "occupancy": net.occupancy(),
+            "dropped": net.dropped,
+        }
 
     @route("POST", r"/w/nodes/(?P<nid>\d+)/start")
     def start_node(self, body, nid):
@@ -210,6 +246,9 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length).decode() if length else ""
         status, payload = self.ws.dispatch(method, self.path, body)
+        if isinstance(payload, RawResponse):
+            self._respond(status, payload.content_type, payload.body.encode())
+            return
         self._respond(status, "application/json", json.dumps(payload).encode())
 
     def do_GET(self):

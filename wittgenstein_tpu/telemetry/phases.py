@@ -1,0 +1,61 @@
+"""Shared per-phase tick profiling: ONE timing loop for both entry
+points (bench.py phase_profile() and scripts/phase_profile.py), spans
+recorded on the telemetry tracer.
+
+The measurement pattern both callers used to duplicate: jit a
+`lax.scan` of `vmap(phase_fn)` over the stacked states, run once to
+compile + warm, then time a second run and divide by the scan length.
+Phases overlap by construction (delivery is part of the full step), so
+the numbers are an op-cost RANKING, not a partition — both callers
+document this; keeping the loop here keeps the caveat true in one
+place.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from .trace import SpanTracer, maybe_span
+
+
+def scan_phase_seconds(
+    states,
+    phases: Dict[str, Callable],
+    scans: int = 25,
+    tracer: Optional[SpanTracer] = None,
+) -> Dict[str, float]:
+    """Seconds per iteration for each named phase fn (state -> state),
+    vmapped over the leading replica axis of `states` and scanned
+    `scans` times inside one jit.  Compile+warm and the timed run are
+    recorded as spans when a tracer is given."""
+    import jax
+    from jax import lax
+
+    out: Dict[str, float] = {}
+    for name, fn in phases.items():
+        def body(s, _, fn=fn):
+            return jax.vmap(fn)(s), None
+
+        stepped = jax.jit(lambda s, body=body: lax.scan(body, s, None, length=scans)[0])
+        with maybe_span(tracer, "compile+warm", phase=name, scans=scans):
+            jax.block_until_ready(stepped(states))
+        with maybe_span(tracer, "measure", phase=name, scans=scans):
+            t0 = time.perf_counter()
+            jax.block_until_ready(stepped(states))
+            out[name] = (time.perf_counter() - t0) / scans
+    return out
+
+
+def engine_phase_fns(net) -> Dict[str, Callable]:
+    """The engine-generic phase set (what bench's --phase-profile
+    reports): full step, delivery+clear, delivery+emission-apply,
+    protocol tick, beat."""
+    proto = net.protocol
+    return {
+        "full_step": net.step,
+        "delivery": net._phase_deliver,
+        "deliver_apply": net._phase_deliver_apply,
+        "protocol_tick": lambda s: proto.tick(net, s),
+        "beat": lambda s: proto.tick_beat(net, s),
+    }

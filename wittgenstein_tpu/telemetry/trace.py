@@ -1,0 +1,128 @@
+"""Host-side span tracer -> Chrome trace-event JSON.
+
+tools/profiling.py wraps jax.profiler (device-level traces for
+TensorBoard/Perfetto); this tracer is its HOST complement: explicit,
+dependency-free spans for the phases the host controls — backend probe,
+compile, warm pass, per-chunk execute — written in the Chrome
+trace-event format (the `{"traceEvents": [...]}` JSON object form) so
+chrome://tracing, Perfetto and speedscope all open it directly.
+
+    tracer = SpanTracer()
+    with tracer.span("compile", nodes=4096):
+        compiled = run.lower(states).compile()
+    for i in range(n_chunks):
+        with tracer.span("chunk", index=i):
+            states = compiled(states)
+    tracer.write("bench_trace.json")
+
+Spans nest naturally (same tid, enclosing durations) and are
+threadsafe — each thread gets its own tid lane.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+
+class SpanTracer:
+    """Collects complete ("ph": "X") trace events with microsecond
+    timestamps relative to tracer construction."""
+
+    def __init__(self, process_name: str = "wittgenstein-tpu"):
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._tids = {}  # thread ident -> small stable tid
+        self.events = [
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": os.getpid(),
+                "tid": 0,
+                "args": {"name": process_name},
+            }
+        ]
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._lock:
+            return self._tids.setdefault(ident, len(self._tids))
+
+    def add_span(self, name: str, start_us: float, dur_us: float, **args):
+        """Record a completed span directly (for spans timed elsewhere)."""
+        ev = {
+            "ph": "X",
+            "name": name,
+            "pid": os.getpid(),
+            "tid": self._tid(),
+            "ts": round(start_us, 1),
+            "dur": round(dur_us, 1),
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self.events.append(ev)
+        return ev
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args) -> Iterator[None]:
+        t0 = self._now_us()
+        try:
+            yield
+        finally:
+            self.add_span(name, t0, self._now_us() - t0, **args)
+
+    def instant(self, name: str, **args):
+        ev = {
+            "ph": "i",
+            "name": name,
+            "pid": os.getpid(),
+            "tid": self._tid(),
+            "ts": round(self._now_us(), 1),
+            "s": "t",
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self.events.append(ev)
+        return ev
+
+    def to_json(self) -> dict:
+        return {"traceEvents": list(self.events), "displayTimeUnit": "ms"}
+
+    def write(self, path: str) -> str:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f)
+        return path
+
+
+def validate_chrome_trace(doc: dict) -> None:
+    """Raise ValueError unless `doc` is a well-formed trace-event JSON
+    object (the export-format contract the tests pin)."""
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError("not a trace-event JSON object form")
+    for ev in doc["traceEvents"]:
+        if "ph" not in ev or "name" not in ev:
+            raise ValueError(f"event missing ph/name: {ev!r}")
+        if ev["ph"] == "X" and ("ts" not in ev or "dur" not in ev):
+            raise ValueError(f"complete event missing ts/dur: {ev!r}")
+
+
+@contextlib.contextmanager
+def maybe_span(tracer: Optional[SpanTracer], name: str, **args):
+    """Span when a tracer is present, no-op otherwise (lets call sites
+    stay unconditional)."""
+    if tracer is None:
+        yield
+    else:
+        with tracer.span(name, **args):
+            yield
