@@ -29,6 +29,17 @@ _cache = os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+# -- opt-in strict JAX runtime guards (docs/static_analysis.md) -------------
+# WITT_STRICT_JAX=1 arms the runtime complements of simlint's static
+# checks: reject implicit host<->device transfers (a silent sync inside a
+# jit path is exactly the bug SL103 hunts textually) and check for leaked
+# tracers on every trace.  Not on by default: the guards also flag the
+# benign numpy->device uploads of host-side construction and slow every
+# trace, so this is a diagnostic mode for kernel development, not a gate.
+if os.environ.get("WITT_STRICT_JAX") == "1":
+    jax.config.update("jax_transfer_guard", "disallow")
+    jax.config.update("jax_check_tracer_leaks", True)
+
 import pytest  # noqa: E402
 
 # -- fast-tier time budget (VERDICT r4 #7) ----------------------------------

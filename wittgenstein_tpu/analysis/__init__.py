@@ -1,0 +1,31 @@
+"""simlint: static contract checking for batched protocols and jit paths.
+
+The engine's correctness rests on conventions no runtime check enforces:
+`deliver` must not touch the engine-owned `msg_*` store, `tick_beat` must
+make exactly `BEAT_SEND_CALLS` latency draws so beat gating never perturbs
+the RNG stream, telemetry must leave sim state bit-identical, and every
+kernel must be shape/dtype-stable under jit.  A violation surfaces — if at
+all — as a distribution-parity failure hours into a TPU campaign.  This
+package turns those conventions into machine-checked rules that fail in
+seconds on CPU CI:
+
+  * `ast_lint`       — AST rules over the whole package (tracer-unsafe
+                       Python, host impurity in jit paths, dtype-drift
+                       hazards, protocol-contract rules);
+  * `contracts`      — abstract-eval checks over every registered batched
+                       protocol (`jax.eval_shape`/`jax.make_jaxpr`):
+                       SimState tree/shape/dtype/weak-type preservation,
+                       msg-store ownership, telemetry neutrality, and a
+                       recompilation sentry;
+  * `rng_audit`      — trace-level audit counting `latency_arrivals`
+                       draws in `tick_beat` against `BEAT_SEND_CALLS`;
+  * `registry_check` — registry/test coverage meta-rule for
+                       `protocols/*_batched.py`.
+
+Run locally: `python -m wittgenstein_tpu.analysis --strict`
+(see docs/static_analysis.md for the rule catalog and suppression syntax).
+"""
+
+from .findings import Finding, RULES, Severity  # noqa: F401
+
+__all__ = ["Finding", "RULES", "Severity"]

@@ -1,0 +1,327 @@
+"""Abstract-eval contract checks (SL401-SL404).
+
+These rules run the real engine code under JAX's abstract interpreter
+instead of reading its text: every protocol registered in
+`core.registries.registry_batched_protocols` is built at a small analysis
+scale and its kernels are traced with `jax.eval_shape` / `jax.make_jaxpr`.
+That catches the contract violations an AST pass cannot see — a `deliver`
+that rewrites an engine-owned store column three calls deep, a `tick`
+whose output dtypes drift from its input (forcing a recompile every
+chained `run_ms`), a telemetry side-car that perturbs sim dynamics.
+
+Rules:
+
+SL401  step() must preserve the SimState tree: same treedef, and every
+       leaf keeps its shape and dtype (no silent f32->f64 or weak-type
+       promotion through a full tick).
+SL402  deliver() must not write engine-owned fields: tracing it to a
+       jaxpr, the outvar for every engine-owned leaf must be the SAME
+       variable as the invar (a pure passthrough), unless the field is
+       declared in DELIVER_MAY_TOUCH.
+SL403  telemetry must be bit-neutral: with_telemetry() must leave every
+       non-tele leaf's aval unchanged under eval_shape AND one concrete
+       step must produce bit-identical non-tele leaves.
+SL404  recompile sentry: step() output avals (including weak_type) must
+       equal input avals so chained run_ms calls hit the jit cache, and
+       two independent traces must yield the same jaxpr (no
+       trace-nondeterminism from unordered Python iteration).
+
+Protocol-level suppression: list rule ids in the protocol class's
+SIMLINT_SUPPRESS tuple (the dynamic analog of `# simlint: disable=`).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Any, List, Optional, Tuple
+
+from .findings import Finding, Severity
+
+_MAX_LEAF_REPORTS = 4  # per rule per protocol; the rest are summarized
+
+
+def _cpu_jax():
+    """Import jax pinned to CPU (the analysis pass must not grab an
+    accelerator or depend on one being present)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # platform already locked in is fine
+    return jax
+
+
+def _proto_location(protocol) -> Tuple[str, int]:
+    """(source file, class def line) of a protocol instance's class —
+    where contract findings anchor."""
+    cls = type(protocol)
+    try:
+        path = inspect.getsourcefile(cls) or "<unknown>"
+        _, line = inspect.getsourcelines(cls)
+    except (OSError, TypeError):
+        path, line = "<unknown>", 1
+    return path, line
+
+
+def _leaf_paths(jax, tree) -> List[Tuple[str, Any]]:
+    """[(dotted path, leaf)] in flatten order."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(jax.tree_util.keystr(kp), leaf) for kp, leaf in flat]
+
+
+def _aval(leaf) -> Tuple[tuple, str, bool]:
+    shape = tuple(getattr(leaf, "shape", ()))
+    dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+    weak = bool(getattr(leaf, "weak_type", False))
+    return shape, dtype, weak
+
+
+def _fingerprint(jax, tree) -> List[Tuple[str, tuple, str, bool]]:
+    return [(p,) + _aval(l) for p, l in _leaf_paths(jax, tree)]
+
+
+def _diff_fingerprints(fp_in, fp_out) -> List[str]:
+    """Human-readable per-leaf diffs (path-keyed; structure mismatch is
+    reported separately via treedef)."""
+    by_path = {p: rest for p, *rest in fp_in}
+    msgs = []
+    for p, *rest in fp_out:
+        if p not in by_path:
+            msgs.append(f"{p}: leaf appears only in output")
+        elif by_path[p] != rest:
+            si, di, wi = by_path[p]
+            so, do, wo = rest
+            msgs.append(
+                f"{p}: {si}/{di}{'(weak)' if wi else ''} -> "
+                f"{so}/{do}{'(weak)' if wo else ''}"
+            )
+    out_paths = {p for p, *_ in fp_out}
+    for p in by_path:
+        if p not in out_paths:
+            msgs.append(f"{p}: leaf disappears in output")
+    return msgs
+
+
+def _mk(rule, path, line, msg, suppress) -> Optional[Finding]:
+    if rule in suppress:
+        return None
+    return Finding(rule=rule, path=path, line=line, message=msg,
+                   severity=Severity.ERROR)
+
+
+def _check_structure(jax, name, net, state, path, line, suppress):
+    """SL401: step preserves tree structure + leaf shape/dtype."""
+    findings = []
+    try:
+        out = jax.eval_shape(net.step, state)
+    except Exception as e:  # abstract eval itself failing IS the finding
+        f = _mk("SL401", path, line,
+                f"[{name}] step() failed abstract evaluation: "
+                f"{type(e).__name__}: {e}", suppress)
+        return [f] if f else [], None
+    tin = jax.tree_util.tree_structure(state)
+    tout = jax.tree_util.tree_structure(out)
+    if tin != tout:
+        f = _mk("SL401", path, line,
+                f"[{name}] step() changes the SimState tree structure "
+                f"(in={tin}, out={tout})", suppress)
+        return [f] if f else [], out
+    diffs = _diff_fingerprints(
+        [(p,) + _aval(l)[:2] + (False,) for p, l in _leaf_paths(jax, state)],
+        [(p,) + _aval(l)[:2] + (False,) for p, l in _leaf_paths(jax, out)],
+    )
+    for d in diffs[:_MAX_LEAF_REPORTS]:
+        f = _mk("SL401", path, line,
+                f"[{name}] step() changes leaf shape/dtype: {d}", suppress)
+        if f:
+            findings.append(f)
+    if len(diffs) > _MAX_LEAF_REPORTS:
+        f = _mk("SL401", path, line,
+                f"[{name}] ... and {len(diffs) - _MAX_LEAF_REPORTS} more "
+                f"leaf shape/dtype changes", suppress)
+        if f:
+            findings.append(f)
+    return findings, out
+
+
+def _check_msg_ownership(jax, name, net, state, path, line, suppress):
+    """SL402: deliver() leaves engine-owned leaves as pure passthroughs."""
+    from ..engine.core import SimState
+    from ..engine.protocol import ENGINE_OWNED_FIELDS
+
+    vstate, _due, deliver, _ctx = net.delivery_view(state)
+
+    def deliver_state(vs, mask):
+        pstate, _em = net.protocol.deliver(net, vs, mask)
+        return pstate
+
+    try:
+        closed, out_shape = jax.make_jaxpr(deliver_state, return_shape=True)(
+            vstate, deliver
+        )
+    except Exception as e:
+        f = _mk("SL402", path, line,
+                f"[{name}] deliver() failed tracing on the delivery view: "
+                f"{type(e).__name__}: {e}", suppress)
+        return [f] if f else []
+    if jax.tree_util.tree_structure(out_shape) != jax.tree_util.tree_structure(
+        vstate
+    ):
+        f = _mk("SL402", path, line,
+                f"[{name}] deliver() changes the SimState tree structure, "
+                "so field ownership cannot be checked", suppress)
+        return [f] if f else []
+
+    # leaf index ranges per SimState field (NamedTuple flattens in field
+    # order, and the output tree matches, so invar k <-> outvar k)
+    offsets = {}
+    i = 0
+    for fname, sub in zip(SimState._fields, vstate):
+        n = len(jax.tree_util.tree_leaves(sub))
+        offsets[fname] = (i, i + n)
+        i += n
+    invars = closed.jaxpr.invars
+    outvars = closed.jaxpr.outvars
+
+    allowed = set(getattr(net.protocol, "DELIVER_MAY_TOUCH", ()) or ())
+    findings = []
+    for fname in ENGINE_OWNED_FIELDS:
+        if fname in allowed:
+            continue
+        a, b = offsets[fname]
+        touched = [k for k in range(a, b) if outvars[k] is not invars[k]]
+        if touched:
+            f = _mk("SL402", path, line,
+                    f"[{name}] deliver() writes engine-owned field "
+                    f"'{fname}' ({len(touched)} leaf(s) are not input "
+                    "passthroughs); return emissions instead, or declare "
+                    "it in DELIVER_MAY_TOUCH", suppress)
+            if f:
+                findings.append(f)
+    return findings
+
+
+def _check_telemetry_neutral(jax, name, net, state, path, line, suppress):
+    """SL403: instrumentation leaves non-tele leaves bit-identical."""
+    import numpy as np
+
+    from ..telemetry.state import TelemetryConfig
+
+    findings = []
+    try:
+        tnet, tstate = net.with_telemetry(state, TelemetryConfig(snapshots=0))
+        out_plain = jax.eval_shape(net.step, state)
+        out_tele = jax.eval_shape(tnet.step, tstate)
+    except Exception as e:
+        f = _mk("SL403", path, line,
+                f"[{name}] telemetry instrumentation failed: "
+                f"{type(e).__name__}: {e}", suppress)
+        return [f] if f else []
+    fp_p = [x for x in _fingerprint(jax, out_plain._replace(tele=()))]
+    fp_t = [x for x in _fingerprint(jax, out_tele._replace(tele=()))]
+    diffs = _diff_fingerprints(fp_p, fp_t)
+    for d in diffs[:_MAX_LEAF_REPORTS]:
+        f = _mk("SL403", path, line,
+                f"[{name}] telemetry changes a non-tele leaf aval: {d}",
+                suppress)
+        if f:
+            findings.append(f)
+    if diffs:
+        return findings
+
+    # concrete one-step cross-check: the side-car must be bit-neutral
+    s_plain = net.step(state)
+    s_tele = tnet.step(tstate)
+    for (p, a), (_, b) in zip(
+        _leaf_paths(jax, s_plain._replace(tele=())),
+        _leaf_paths(jax, s_tele._replace(tele=())),
+    ):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            f = _mk("SL403", path, line,
+                    f"[{name}] telemetry perturbs sim dynamics: leaf {p} "
+                    "differs bitwise after one instrumented step", suppress)
+            if f:
+                findings.append(f)
+            break
+    return findings
+
+
+def _check_recompile(jax, name, net, state, out_shape, path, line, suppress):
+    """SL404: step output avals == input avals (jit-cache stability) and
+    trace determinism."""
+    findings = []
+    if out_shape is not None:
+        diffs = _diff_fingerprints(
+            _fingerprint(jax, state), _fingerprint(jax, out_shape)
+        )
+        for d in diffs[:_MAX_LEAF_REPORTS]:
+            f = _mk("SL404", path, line,
+                    f"[{name}] step() output aval drifts from input "
+                    f"(chained run_ms will recompile every call): {d}",
+                    suppress)
+            if f:
+                findings.append(f)
+        if diffs:
+            return findings
+    try:
+        j1 = str(jax.make_jaxpr(net.step)(state))
+        j2 = str(jax.make_jaxpr(net.step)(state))
+    except Exception as e:
+        f = _mk("SL404", path, line,
+                f"[{name}] step() failed tracing: {type(e).__name__}: {e}",
+                suppress)
+        return [f] if f else []
+    if j1 != j2:
+        f = _mk("SL404", path, line,
+                f"[{name}] step() traces to different jaxprs on identical "
+                "inputs (nondeterministic trace: unordered dict/set "
+                "iteration in a kernel?)", suppress)
+        if f:
+            findings.append(f)
+    return findings
+
+
+def check_entry(entry, root: str = ".") -> List[Finding]:
+    """Run SL401-SL404 for one registry entry; [] when clean or when the
+    entry opts out of contract checks (standalone engines)."""
+    jax = _cpu_jax()
+    if not entry.contract_checks:
+        return []
+    net, state = entry.factory()
+    path, line = _proto_location(net.protocol)
+    try:
+        path = os.path.relpath(path, root)
+    except ValueError:
+        pass
+    suppress = set(getattr(net.protocol, "SIMLINT_SUPPRESS", ()) or ())
+
+    findings, out_shape = _check_structure(
+        jax, entry.name, net, state, path, line, suppress
+    )
+    findings += _check_msg_ownership(
+        jax, entry.name, net, state, path, line, suppress
+    )
+    findings += _check_telemetry_neutral(
+        jax, entry.name, net, state, path, line, suppress
+    )
+    findings += _check_recompile(
+        jax, entry.name, net, state, out_shape, path, line, suppress
+    )
+    return findings
+
+
+def check_all(root: str = ".", names=None) -> List[Finding]:
+    """Contract-check every registered batched protocol (or the named
+    subset).  Imports the registry lazily so `--skip-contracts` runs
+    never pay for protocol imports."""
+    from ..core.registries import registry_batched_protocols
+
+    findings: List[Finding] = []
+    for entry in registry_batched_protocols.entries():
+        if names and entry.name not in names:
+            continue
+        findings.extend(check_entry(entry, root=root))
+    return findings

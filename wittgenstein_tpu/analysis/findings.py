@@ -1,0 +1,133 @@
+"""Finding model, rule catalog, and suppression comments.
+
+Every check in the package reports through `Finding`: a rule id from the
+catalog below, a file:line anchor, and a message.  AST findings anchor at
+the offending node; abstract-eval findings anchor at the protocol class's
+definition line so the report is always clickable.
+
+Suppression is per-line (`# simlint: disable=SL104` on the flagged line,
+comma-separated for several rules) or per-file
+(`# simlint: disable-file=SL104` anywhere in the file).  Dynamic checks
+(SL4xx) accept class-level suppression via the protocol's
+`SIMLINT_SUPPRESS` contract metadata (engine/protocol.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import re
+from typing import Dict, List, Optional
+
+
+class Severity(enum.Enum):
+    ERROR = "error"
+    WARNING = "warning"
+
+
+# rule id -> one-line description (the catalog; docs/static_analysis.md is
+# the prose version and tests assert the two stay in sync)
+RULES: Dict[str, str] = {
+    # -- AST: tracer safety / host purity / dtype drift ----------------------
+    "SL101": "tracer-unsafe branch: Python `if`/`while`/`bool()` on a "
+    "traced value inside kernel code",
+    "SL102": "host impurity in a jit path: time.*/random.*/np.random/print "
+    "inside kernel code",
+    "SL103": "host conversion of a traced value: float()/int()/.item()/"
+    "np.asarray(state...) inside kernel code",
+    "SL104": "dtype-drift hazard: dtype-less jnp constructor "
+    "(zeros/ones/arange) or weak-typed numeric literal in kernel code",
+    # -- AST: protocol contract ---------------------------------------------
+    "SL201": "deliver() writes an engine-owned msg_*/ovf_*/wheel column "
+    "(the engine owns the message store)",
+    "SL202": "tick_beat override without a BEAT_PERIOD/BEAT_SEND_CALLS "
+    "declaration in the module (beat gating would corrupt the RNG stream)",
+    "SL203": "self.mtype(name) with a name missing from the class's "
+    "MSG_TYPES literal",
+    "SL204": "payload contract mismatch: Emission(payload=...) with "
+    "PAYLOAD_WIDTH 0, or msg_payload indexed past PAYLOAD_WIDTH",
+    # -- registry / test coverage meta-rule ----------------------------------
+    "SL301": "batched protocol not registered in core/registries.py or "
+    "missing a tests/test_* parity file",
+    # -- abstract-eval contract checks ---------------------------------------
+    "SL401": "kernel hook does not preserve the SimState tree structure, "
+    "shapes, or dtypes (weak-type promotion counts)",
+    "SL402": "deliver() output msg store is not a passthrough of its input "
+    "(jaxpr-level ownership check)",
+    "SL403": "telemetry side-car perturbs non-tele state (instrumented run "
+    "would not be bit-identical)",
+    "SL404": "recompilation sentry: a second trace would miss the jit "
+    "cache (output avals drift or trace is not reproducible)",
+    "SL405": "RNG-stream audit: tick_beat's latency_arrivals draw count "
+    "does not match the declared BEAT_SEND_CALLS",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative when produced by the CLI
+    line: int
+    message: str
+    severity: Severity = Severity.ERROR
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}: {self.rule} "
+            f"[{self.severity.value}] {self.message}"
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "severity": self.severity.value,
+            "message": self.message,
+            "summary": RULES.get(self.rule, ""),
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+
+_DISABLE_RE = re.compile(r"#\s*simlint:\s*disable=([A-Z0-9, ]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*simlint:\s*disable-file=([A-Z0-9, ]+)")
+
+
+def _ids(match_text: str) -> List[str]:
+    return [t.strip() for t in match_text.split(",") if t.strip()]
+
+
+def file_suppressions(source: str) -> List[str]:
+    """Rule ids disabled for the whole file."""
+    out: List[str] = []
+    for m in _DISABLE_FILE_RE.finditer(source):
+        out.extend(_ids(m.group(1)))
+    return out
+
+
+def line_suppressions(source_line: str) -> List[str]:
+    out: List[str] = []
+    for m in _DISABLE_RE.finditer(source_line):
+        out.extend(_ids(m.group(1)))
+    return out
+
+
+def apply_suppressions(
+    findings: List[Finding], source: str, lines: Optional[List[str]] = None
+) -> List[Finding]:
+    """Drop findings suppressed by file- or line-level comments."""
+    if lines is None:
+        lines = source.splitlines()
+    file_off = set(file_suppressions(source))
+    kept = []
+    for f in findings:
+        if f.rule in file_off:
+            continue
+        line = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        if f.rule in line_suppressions(line):
+            continue
+        kept.append(f)
+    return kept

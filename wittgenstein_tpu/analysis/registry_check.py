@@ -1,0 +1,95 @@
+"""Registry-coverage meta-rule (SL301).
+
+Every batched protocol implementation must be (a) registered in
+`core.registries.registry_batched_protocols` so the abstract-eval passes
+enumerate it, and (b) exercised by at least one test module.  This is the
+rule that keeps the OTHER rules honest: a new `protocols/foo_batched.py`
+that never registers would silently escape the contract checks, and CI
+would go green on an unchecked kernel.
+
+Underscore-prefixed modules (`_agg_batched.py`) are shared bases, not
+protocols, and are exempt.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List
+
+from .findings import Finding, Severity
+
+
+def check_registry_coverage(root: str = ".") -> List[Finding]:
+    findings: List[Finding] = []
+    proto_dir = os.path.join(root, "wittgenstein_tpu", "protocols")
+    tests_dir = os.path.join(root, "tests")
+    if not os.path.isdir(proto_dir):
+        return findings
+
+    modules = sorted(
+        os.path.basename(p)[:-3]
+        for p in glob.glob(os.path.join(proto_dir, "*_batched.py"))
+        if not os.path.basename(p).startswith("_")
+    )
+
+    try:
+        from ..core.registries import registry_batched_protocols
+
+        registered = set(registry_batched_protocols.modules())
+    except Exception as e:
+        findings.append(Finding(
+            rule="SL301",
+            path=os.path.join("wittgenstein_tpu", "core", "registries.py"),
+            line=1,
+            message=f"batched-protocol registry failed to import: "
+                    f"{type(e).__name__}: {e}",
+            severity=Severity.ERROR,
+        ))
+        return findings
+
+    # one pass over the test sources; mention of the module name (import
+    # or factory reference) counts as coverage
+    test_sources = {}
+    for tp in sorted(glob.glob(os.path.join(tests_dir, "test_*.py"))):
+        try:
+            with open(tp, "r", encoding="utf-8") as fh:
+                test_sources[tp] = fh.read()
+        except OSError:
+            continue
+
+    for mod in modules:
+        relpath = os.path.join("wittgenstein_tpu", "protocols", mod + ".py")
+        if mod not in registered:
+            findings.append(Finding(
+                rule="SL301",
+                path=relpath,
+                line=1,
+                message=f"protocols/{mod}.py is not registered in "
+                        "core.registries.registry_batched_protocols — the "
+                        "abstract-eval contract checks cannot see it",
+                severity=Severity.ERROR,
+            ))
+        if not any(mod in src for src in test_sources.values()):
+            findings.append(Finding(
+                rule="SL301",
+                path=relpath,
+                line=1,
+                message=f"protocols/{mod}.py has no tests/test_*.py "
+                        "referencing it (parity coverage missing)",
+                severity=Severity.ERROR,
+            ))
+
+    # dangling registrations: a registry entry whose module file is gone
+    for mod in sorted(registered - set(modules)):
+        if mod.startswith("_"):
+            continue
+        findings.append(Finding(
+            rule="SL301",
+            path=os.path.join("wittgenstein_tpu", "core", "registries.py"),
+            line=1,
+            message=f"registry lists module '{mod}' but "
+                    f"protocols/{mod}.py does not exist",
+            severity=Severity.ERROR,
+        ))
+    return findings

@@ -1,0 +1,94 @@
+"""Beat RNG audit (SL405).
+
+The beat-gating optimization in `run_ms_batched` only preserves the
+per-event RNG stream if BEAT_SEND_CALLS is exact: off-beat ticks advance
+`send_ctr` by that declared amount instead of executing `tick_beat`, so a
+protocol whose `tick_beat` actually makes a different number of
+`latency_arrivals` draws silently de-synchronizes the stream — the beat
+path and the generic path then simulate DIFFERENT runs, which no shape
+check can see.
+
+This auditor counts the draws at trace time: it shadows the engine's
+`latency_arrivals` with a counting wrapper (an instance attribute, so
+`self.latency_arrivals` calls inside `apply_emission` route through it)
+and traces `tick_beat` once with `jax.make_jaxpr`.  Python-level counting
+during the trace is exact — every draw site executes exactly once while
+tracing, regardless of the masks applied to it.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List
+
+from .contracts import _cpu_jax, _mk, _proto_location
+
+
+def audit_entry(entry, root: str = ".") -> List["Finding"]:
+    """SL405 for one registry entry; [] when clean, exempt, or beat-free."""
+    import os
+
+    jax = _cpu_jax()
+    if not entry.contract_checks:
+        return []
+    net, state = entry.factory()
+    proto = net.protocol
+    path, line = _proto_location(proto)
+    try:
+        path = os.path.relpath(path, root)
+    except ValueError:
+        pass
+    suppress = set(getattr(proto, "SIMLINT_SUPPRESS", ()) or ())
+
+    contract = proto.contract()
+    period = contract["beat_period"]
+    declared = contract["beat_send_calls"]
+    if period is None:
+        if declared:
+            f = _mk("SL405", path, line,
+                    f"[{entry.name}] BEAT_SEND_CALLS={declared} but "
+                    "BEAT_PERIOD is unset — the declaration is dead and "
+                    "will mislead a future beat-gating change", suppress)
+            return [f] if f else []
+        return []
+
+    counted = {"n": 0}
+    orig = net.latency_arrivals  # bound to the original net; same tables
+
+    def counting_latency_arrivals(*args, **kwargs):
+        counted["n"] += 1
+        return orig(*args, **kwargs)
+
+    net2 = copy.copy(net)
+    # instance attribute shadows the class method, so internal
+    # self.latency_arrivals(...) calls (apply_emission) are counted too
+    net2.latency_arrivals = counting_latency_arrivals
+    try:
+        jax.make_jaxpr(lambda s: proto.tick_beat(net2, s))(state)
+    except Exception as e:
+        f = _mk("SL405", path, line,
+                f"[{entry.name}] tick_beat() failed tracing for the RNG "
+                f"audit: {type(e).__name__}: {e}", suppress)
+        return [f] if f else []
+
+    if counted["n"] != declared:
+        f = _mk("SL405", path, line,
+                f"[{entry.name}] tick_beat() makes {counted['n']} "
+                f"latency_arrivals draw(s) but declares "
+                f"BEAT_SEND_CALLS={declared}; off-beat ticks advance "
+                "send_ctr by the declared amount, so the mismatch "
+                "de-synchronizes the RNG stream between the beat-gated "
+                "and generic run paths", suppress)
+        return [f] if f else []
+    return []
+
+
+def audit_all(root: str = ".", names=None) -> List["Finding"]:
+    from ..core.registries import registry_batched_protocols
+
+    findings = []
+    for entry in registry_batched_protocols.entries():
+        if names and entry.name not in names:
+            continue
+        findings.extend(audit_entry(entry, root=root))
+    return findings

@@ -9,7 +9,12 @@ devices.
 """
 
 from .core import BatchedNetwork, Emission, SimState, replicate_state, stack_states
-from .protocol import BatchedProtocol
+from .protocol import (
+    ENGINE_OWNED_FIELDS,
+    HOST_HOOKS,
+    KERNEL_HOOKS,
+    BatchedProtocol,
+)
 from .rng import hash32, pseudo_delta
 
 __all__ = [

@@ -566,11 +566,15 @@ class BatchedNetwork:
             )
         return q
 
-    def _deliver_and_clear(self, state: SimState):
-        """One tick's delivery: gather the due view (window rows + overflow
-        lane), update receiver counters, run protocol.deliver on the view,
-        then clear delivered entries and repack the visited rows to a dense
-        prefix.  Returns (state, emissions)."""
+    def delivery_view(self, state: SimState):
+        """Build the flat delivery VIEW protocol.deliver sees: msg_* columns
+        are `[D]` gathers of the due wheel window rows + the overflow lane
+        (see the module docstring).  Returns (vstate, due, deliver, ctx):
+        `due` is bool[D] (arrival <= t), `deliver` additionally applies the
+        delivery-time down/partition discards, and `ctx` carries the wheel
+        internals `_deliver_and_clear` needs for the post-deliver repack.
+        Exposed as API so the static checker (wittgenstein_tpu.analysis)
+        can trace `deliver` against the exact view contract."""
         t = state.time
         w, b = self.wheel_rows, self.wheel_slots
         q = self._window()
@@ -600,6 +604,27 @@ class BatchedNetwork:
         pid_t = self.partition_id(state, state.x[view_to])
         deliver = due & ~state.down[view_to] & (pid_f == pid_t)
 
+        vstate = state._replace(
+            msg_valid=view_valid,
+            msg_arrival=view_arrival,
+            msg_from=view_from,
+            msg_to=view_to,
+            msg_type=view_type,
+            msg_payload=view_payload,
+        )
+        ctx = (rows, wv, wa, wf, wt, wk, wp, q, b)
+        return vstate, due, deliver, ctx
+
+    def _deliver_and_clear(self, state: SimState):
+        """One tick's delivery: gather the due view (window rows + overflow
+        lane), update receiver counters, run protocol.deliver on the view,
+        then clear delivered entries and repack the visited rows to a dense
+        prefix.  Returns (state, emissions)."""
+        vview, due, deliver, ctx = self.delivery_view(state)
+        rows, wv, wa, wf, wt, wk, wp, q, b = ctx
+        view_to = vview.msg_to
+        view_type = vview.msg_type
+
         # receiver counters skip size-0 (task-style) types, mirroring the
         # Task exemption at Network.java:522-526
         sizes = jnp.asarray(self._msg_sizes, jnp.int32)[view_type]
@@ -628,12 +653,12 @@ class BatchedNetwork:
         # [D] gathers; protocols must not touch msg_* (the engine owns the
         # store), so the wheel fields are restored below
         vstate = state._replace(
-            msg_valid=view_valid,
-            msg_arrival=view_arrival,
-            msg_from=view_from,
-            msg_to=view_to,
-            msg_type=view_type,
-            msg_payload=view_payload,
+            msg_valid=vview.msg_valid,
+            msg_arrival=vview.msg_arrival,
+            msg_from=vview.msg_from,
+            msg_to=vview.msg_to,
+            msg_type=vview.msg_type,
+            msg_payload=vview.msg_payload,
         )
         pstate, emissions = self.protocol.deliver(self, vstate, deliver)
 

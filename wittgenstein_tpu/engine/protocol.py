@@ -13,6 +13,45 @@ from typing import Any, List, Tuple
 
 import jax.numpy as jnp
 
+# ---------------------------------------------------------------------------
+# Machine-readable contract metadata (consumed by wittgenstein_tpu.analysis).
+# These tuples ARE the contract prose above, in checkable form: simlint's
+# AST rules and abstract-eval passes import them instead of hard-coding
+# field lists, so an engine refactor that moves a column updates the
+# checker automatically.
+# ---------------------------------------------------------------------------
+
+# SimState fields owned by the ENGINE: protocol hooks must never write them
+# (`deliver` returns emissions instead of touching the store; the engine
+# ticks counters and the clock).  A protocol with a genuine exception
+# declares it in DELIVER_MAY_TOUCH.
+ENGINE_OWNED_FIELDS = (
+    "time",
+    "seed",
+    "send_ctr",
+    "msg_valid",
+    "msg_arrival",
+    "msg_from",
+    "msg_to",
+    "msg_type",
+    "msg_payload",
+    "whl_fill",
+    "ovf_valid",
+    "ovf_arrival",
+    "ovf_from",
+    "ovf_to",
+    "ovf_type",
+    "ovf_payload",
+    "msg_head",
+    "dropped",
+    "tele",
+)
+
+# Hooks traced under jit (tracer-safety rules apply) vs host-side
+# construction hooks (plain Python allowed).
+KERNEL_HOOKS = ("deliver", "tick", "tick_beat", "tick_post", "all_done")
+HOST_HOOKS = ("proto_init", "initial_emissions", "msg_size", "n_msg_types", "mtype")
+
 
 class BatchedProtocol:
     """Subclass and override.  MSG_TYPES maps message-type names to the int
@@ -47,6 +86,35 @@ class BatchedProtocol:
     # stream is IDENTICAL to the ungated path (where the masked beat call
     # still ticked the counter) — beat gating changes cost, never draws.
     BEAT_SEND_CALLS: int = 0
+    # Engine-owned SimState fields this protocol's deliver() is ALLOWED to
+    # write (empty for every current protocol; a future exception must be
+    # declared here so simlint's ownership check stays exact).
+    DELIVER_MAY_TOUCH: tuple = ()
+    # simlint rule ids (e.g. "SL404") suppressed for this protocol's
+    # abstract-eval checks — the dynamic analog of the per-line
+    # `# simlint: disable=RULE` comment.  Use sparingly, with a comment.
+    SIMLINT_SUPPRESS: tuple = ()
+
+    def contract(self) -> dict:
+        """Machine-readable contract summary (instance-level: factories may
+        set BEAT_* dynamically).  This is what simlint audits against."""
+        msg_types = self.MSG_TYPES
+        return {
+            "protocol": type(self).__name__,
+            "msg_types": list(msg_types) if msg_types else [],
+            "n_msg_types": self.n_msg_types(),
+            "payload_width": int(self.PAYLOAD_WIDTH),
+            "tick_interval": self.TICK_INTERVAL,
+            "time_quantum": int(self.TIME_QUANTUM),
+            "beat_period": self.BEAT_PERIOD,
+            "beat_residues": (
+                tuple(self.BEAT_RESIDUES) if self.BEAT_RESIDUES else None
+            ),
+            "beat_send_calls": int(self.BEAT_SEND_CALLS),
+            "engine_owned_fields": list(ENGINE_OWNED_FIELDS),
+            "deliver_may_touch": list(self.DELIVER_MAY_TOUCH),
+            "simlint_suppress": list(self.SIMLINT_SUPPRESS),
+        }
 
     def n_msg_types(self) -> int:
         return max(1, len(self.MSG_TYPES))

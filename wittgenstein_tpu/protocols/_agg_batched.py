@@ -546,7 +546,9 @@ class BitsetAggBase(BatchedProtocol):
             dest = jnp.where(meta_l[:, 5] > 0, meta_l[:, 0] // n_loc, p_sz)
             order = jnp.argsort(dest)
             dsort = dest[order]
-            pos = jnp.arange(m_loc) - jnp.searchsorted(dsort, dsort, side="left")
+            pos = jnp.arange(m_loc, dtype=jnp.int32) - jnp.searchsorted(
+                dsort, dsort, side="left"
+            ).astype(jnp.int32)
             overflow = jnp.sum(
                 ((pos >= bucket_cap) & (dsort < p_sz)).astype(jnp.int32)
             )

@@ -131,7 +131,7 @@ class BatchedAvalanche(BatchedProtocol):
         active = proto["active"]
         complete = active & ((cf[:, 1] + cf[:, 2]) >= p.k)
         other = jnp.where(color == 1, 2, 1).astype(jnp.int32)
-        rows = jnp.arange(self.n_nodes)
+        rows = jnp.arange(self.n_nodes, dtype=jnp.int32)
         cf_other = cf[rows, other]
         cf_mine = cf[rows, jnp.clip(color, 0, 2)]
         flip = complete & (cf_other > p.ak)

@@ -160,16 +160,16 @@ class BatchedHandelEth2(BatchedProtocol):
         skipping finished peers (blacklist is empty: nothing ever fails
         verification).  Returns (dests [N, count], ok [N, count])."""
         n = self.n_nodes
-        ids = jnp.arange(n)
+        ids = jnp.arange(n, dtype=jnp.int32)
         mp = self.peers.shape[2]
         plist = self.peers[ids, jnp.clip(sel_l, 0, self.nl - 1)]  # [N, mp]
         pos = proto["pos"][ids, sel_p, sel_l]
         fin = proto["fin_peers"][ids, sel_p]  # [N, nw]
         pv = jnp.clip(plist, 0, n - 1)
-        fbit = (fin[jnp.arange(n)[:, None], pv // 32] >> (pv % 32).astype(jnp.uint32)) & 1
+        fbit = (fin[ids[:, None], pv // 32] >> (pv % 32).astype(jnp.uint32)) & 1
         eligible = (plist >= 0) & (fbit == 0)
         # rotate eligibility by pos and take the first `count`
-        idxs = (pos[:, None] + jnp.arange(mp)[None, :]) % jnp.maximum(
+        idxs = (pos[:, None] + jnp.arange(mp, dtype=jnp.int32)[None, :]) % jnp.maximum(
             1, jnp.sum(plist >= 0, axis=1)
         )[:, None]
         rot_ok = jnp.take_along_axis(eligible, idxs, axis=1)
@@ -182,7 +182,11 @@ class BatchedHandelEth2(BatchedProtocol):
             any_hit = jnp.any(hit, axis=1)
             first = jnp.argmax(hit, axis=1)
             dests.append(
-                jnp.where(any_hit, rot_peer[jnp.arange(n), first], 0)
+                jnp.where(
+                    any_hit,
+                    rot_peer[jnp.arange(n, dtype=jnp.int32), first],
+                    0,
+                )
             )
             oks.append(any_hit)
             steps.append(jnp.where(any_hit, first + 1, 0))
@@ -596,7 +600,7 @@ class BatchedHandelEth2(BatchedProtocol):
             ),
             inc_l,
         )
-        new_ind = ind_l.at[jnp.arange(n), proto["v_hash"]].max(
+        new_ind = ind_l.at[jnp.arange(n, dtype=jnp.int32), proto["v_hash"]].max(
             self._onehot_w(proto["v_from"])
         )
         proto["inc"] = proto["inc"].at[jnp.where(still, ids, n), pi, l].set(

@@ -159,7 +159,7 @@ class BatchedP2PHandel(BatchedProtocol):
             v_new = jnp.sum(arrivals & ~verified, axis=1)
             insert = has_new & (v_new > v_min)
             proto["cand"] = cand.at[
-                jnp.where(insert, jnp.arange(n), n), worst
+                jnp.where(insert, jnp.arange(n, dtype=jnp.int32), n), worst
             ].set(arrivals, mode="drop")
         return state._replace(proto=proto), []
 

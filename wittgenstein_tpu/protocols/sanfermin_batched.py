@@ -312,14 +312,14 @@ class BatchedSanFermin(BatchedProtocol):
         )
 
         new_cpl = jnp.where(descend, proto["cpl"] - 1, proto["cpl"])
+        lvl_row = jnp.arange(w + 1, dtype=jnp.int32)[None, :]
         proto["cache_val"] = jnp.where(
-            descend[:, None]
-            & (jnp.arange(w + 1)[None, :] == new_cpl[:, None]),
+            descend[:, None] & (lvl_row == new_cpl[:, None]),
             agg[:, None],
             proto["cache_val"],
         )
         proto["cache_ok"] = proto["cache_ok"] | (
-            descend[:, None] & (jnp.arange(w + 1)[None, :] == new_cpl[:, None])
+            descend[:, None] & (lvl_row == new_cpl[:, None])
         )
         proto["agg"] = agg
         proto["cpl"] = new_cpl

@@ -265,7 +265,7 @@ class BatchedSanFerminCappos(BatchedProtocol):
             descended = descended | active
             # continue descending only through already-cached levels
             active = active & proto["cache_any"][
-                jnp.arange(n), jnp.clip(proto["cpl"], 0, w)
+                jnp.arange(n, dtype=jnp.int32), jnp.clip(proto["cpl"], 0, w)
             ]
         proto["swapping"] = proto["swapping"] & ~commit
 
