@@ -73,15 +73,69 @@ PROBE_ATTEMPTS = 2
 PROBE_TIMEOUT_S = 120
 
 
+def _probe_cache_path() -> str:
+    """Per-process-tree probe-verdict cache in /tmp: keyed by uid +
+    session id so a bench ladder (parent + --rung subprocesses + helper
+    scripts) probes the backend ONCE instead of burning PROBE_ATTEMPTS x
+    PROBE_TIMEOUT_S in every child when the tunnel is dead."""
+    import tempfile
+
+    try:
+        scope = os.getsid(0)
+    except (AttributeError, OSError):  # non-POSIX / detached
+        scope = os.getppid()
+    return os.path.join(
+        tempfile.gettempdir(), f"witt_bench_probe_{os.getuid()}_{scope}.json"
+    )
+
+
+# cached verdicts older than this are stale (a tunnel can come back)
+PROBE_CACHE_TTL_S = 3600
+
+
+def _read_probe_cache(path: str):
+    try:
+        with open(path) as f:
+            cached = json.load(f)
+        if time.time() - float(cached.get("ts", 0)) > PROBE_CACHE_TTL_S:
+            return None
+        if not cached.get("platform"):
+            return None
+        return cached
+    except (OSError, ValueError):
+        return None
+
+
+def _write_probe_cache(path: str, verdict: dict) -> None:
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump({**verdict, "ts": time.time()}, f)
+        os.replace(tmp, path)  # atomic: concurrent rungs see old or new
+    except OSError:
+        pass  # cache is an optimization, never a failure
+
+
 def _probe_backend() -> dict:
     """Decide which platform to run on, WITHOUT touching jax in this
     process (a dead TPU tunnel makes jax.devices() HANG rather than raise —
     see tests/conftest.py — so the probe runs in killable subprocesses).
+    The verdict is cached in /tmp for the process tree (see
+    _probe_cache_path); WITT_BENCH_PLATFORM skips probe AND cache.
 
     Returns {"platform", "attempts": [...], "fallback_reason"}."""
     forced = os.environ.get("WITT_BENCH_PLATFORM")
     if forced:
         return {"platform": forced, "attempts": [], "fallback_reason": f"forced by WITT_BENCH_PLATFORM={forced}"}
+
+    cache_path = _probe_cache_path()
+    cached = _read_probe_cache(cache_path)
+    if cached is not None:
+        return {
+            "platform": cached["platform"],
+            "attempts": [],
+            "fallback_reason": f"cached probe verdict ({cache_path})",
+        }
 
     attempts = []
     for i in range(PROBE_ATTEMPTS):
@@ -107,7 +161,9 @@ def _probe_backend() -> dict:
             attempts.append(rec)
             if r.returncode == 0 and r.stdout.strip():
                 platform = r.stdout.split("|")[0].strip()
-                return {"platform": platform, "attempts": attempts, "fallback_reason": None}
+                verdict = {"platform": platform, "attempts": attempts, "fallback_reason": None}
+                _write_probe_cache(cache_path, {"platform": platform})
+                return verdict
         except subprocess.TimeoutExpired:
             attempts.append(
                 {
@@ -119,6 +175,9 @@ def _probe_backend() -> dict:
             )
         if i < PROBE_ATTEMPTS - 1:
             time.sleep(5)
+    # cache the CPU fallback too: the children of a ladder whose tunnel
+    # is dead must not re-burn the full probe budget each
+    _write_probe_cache(cache_path, {"platform": "cpu"})
     return {
         "platform": "cpu",
         "attempts": attempts,

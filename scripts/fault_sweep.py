@@ -1,0 +1,141 @@
+"""Fault sweep: heterogeneous fault plans across replicas, one compile.
+
+Builds a toy P2PFlood simulation and runs FIVE fault scenarios — a
+fault-free control, a 20% crash at t=200ms, a two-way partition window,
+probabilistic message drop, and latency inflation — as replica rows of
+ONE `run_ms_batched` invocation (the schedules are FaultState data, not
+traced branches, so the whole sweep is a single jit).  Emits an
+availability-vs-latency report plus a JSONL run record, and FAILS
+LOUDLY if the sweep misbehaves: the control row must be bit-identical
+to a fault-free singleton run (fault-off neutrality at full scale), the
+crash row must lose availability, and the drop/inflation counters must
+show their lanes fired.  CI runs this as the tier-1 fault step and
+uploads the output directory as a build artifact.
+
+Usage: python scripts/fault_sweep.py [out_dir]   (default ./fault_sweep)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the dev environment's sitecustomize pins jax_platforms=axon at the
+    # config level; pin the config too (see tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from wittgenstein_tpu.faults import FaultPlan  # noqa: E402
+from wittgenstein_tpu.protocols.p2pflood import P2PFloodParameters  # noqa: E402
+from wittgenstein_tpu.protocols.p2pflood_batched import make_p2pflood  # noqa: E402
+from wittgenstein_tpu.scenarios.sweep import run_fault_sweep  # noqa: E402
+from wittgenstein_tpu.telemetry import RunRecordWriter  # noqa: E402
+
+SIM_MS = 1500
+SEED0 = 0
+
+
+def build_plans(net, state):
+    """Control + four distinct fault lanes on the built population."""
+    n = net.n_nodes
+    live = np.flatnonzero(~np.asarray(state.down))
+    crash_ids = live[len(live) // 4 :][: max(1, len(live) // 5)]  # 20% of live
+    groups = np.arange(n) % 2
+    return [
+        None,  # fault-free control row
+        FaultPlan("crash20@200").crash(crash_ids, at=200),
+        FaultPlan("split@100-600").partition(groups, start=100, end=600),
+        FaultPlan("drop30%").drop(300, start=0),
+        FaultPlan("slow3x").inflate(3000, add_ms=20, start=0),
+    ]
+
+
+def main() -> int:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(ROOT, "fault_sweep")
+    os.makedirs(out_dir, exist_ok=True)
+
+    net, state = make_p2pflood(P2PFloodParameters(), capacity=2048, seed=SEED0)
+    plans = build_plans(net, state)
+    out, records = run_fault_sweep(
+        net, state, plans, sim_ms=SIM_MS, seed0=SEED0, done_cdf_every=100
+    )
+
+    # fault-off neutrality at full scale: the control replica (row 0,
+    # same seed) must be bitwise-identical to a fault-free singleton run
+    single = net.run_ms(state, SIM_MS)
+    for field in state._fields:
+        if field == "faults":
+            continue
+        for a, b in zip(
+            jax.tree_util.tree_leaves(getattr(single, field)),
+            jax.tree_util.tree_leaves(getattr(out, field)),
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b)[0]), (
+                f"control row diverged from fault-free run on {field}"
+            )
+
+    by_label = {r["plan"]["label"]: r for r in records}
+    ctrl = by_label["control"]
+    assert ctrl["availability"] == 1.0, f"control did not finish: {ctrl}"
+    assert sum(ctrl["dropped_by_fault"]) == 0 and sum(ctrl["delayed_by_fault"]) == 0
+    crash = by_label["crash20@200"]
+    assert crash["availability"] < ctrl["availability"], (
+        f"crash plan lost no availability: {crash}"
+    )
+    assert sum(by_label["drop30%"]["dropped_by_fault"]) > 0
+    assert sum(by_label["slow3x"]["delayed_by_fault"]) > 0
+
+    # availability-vs-latency report
+    lines = [
+        f"fault sweep: p2pflood n={net.n_nodes}, sim_ms={SIM_MS}, "
+        f"{len(plans)} plans x 1 replica, ONE run_ms_batched compile",
+        "",
+        f"{'plan':<16} {'avail':>6} {'done p50':>9} {'done p90':>9} "
+        f"{'dropped':>8} {'delayed':>8}",
+    ]
+    for r in records:
+        q = r["done_at_ms"] or {"p50": -1, "p90": -1}
+        lines.append(
+            f"{r['plan']['label']:<16} {r['availability']:>6.2f} "
+            f"{q['p50']:>9} {q['p90']:>9} "
+            f"{sum(r['dropped_by_fault']):>8} {sum(r['delayed_by_fault']):>8}"
+        )
+    report = "\n".join(lines) + "\n"
+    with open(os.path.join(out_dir, "report.txt"), "w") as f:
+        f.write(report)
+    print(report)
+
+    rec_path = os.path.join(out_dir, "run_records.jsonl")
+    RunRecordWriter(rec_path).write(
+        {"kind": "fault_sweep", "records": records},
+        sim_ms=SIM_MS,
+        nodes=net.n_nodes,
+        plans=len(plans),
+    )
+
+    print(
+        json.dumps(
+            {
+                "ok": True,
+                "out_dir": out_dir,
+                "plans": len(plans),
+                "availability": {
+                    r["plan"]["label"]: r["availability"] for r in records
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

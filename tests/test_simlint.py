@@ -346,6 +346,57 @@ def test_sl405_detects_beat_rng_mismatch():
     ) == []
 
 
+def test_sl406_detects_fault_sensitive_protocol():
+    import jax
+    import jax.numpy as jnp
+
+    from wittgenstein_tpu.analysis.contracts import check_entry
+    from wittgenstein_tpu.protocols.pingpong_batched import BatchedPingPong
+
+    class FaultSensitive(BatchedPingPong):
+        # peeks at whether the fault side-car is armed: a neutral
+        # schedule then changes non-fault state, breaking SL406
+        def deliver(self, net, state, deliver_mask):
+            state, em = super().deliver(net, state, deliver_mask)
+            if len(jax.tree_util.tree_leaves(state.faults)) > 0:
+                state = state._replace(
+                    proto={"pong": state.proto["pong"] + jnp.int32(1)}
+                )
+            return state, em
+
+    findings = check_entry(
+        _entry_with_protocol(FaultSensitive), root=str(REPO_ROOT)
+    )
+    assert any(f.rule == "SL406" for f in findings)
+
+
+def test_sl407_detects_deliver_fault_write():
+    import jax.numpy as jnp
+
+    from wittgenstein_tpu.analysis.contracts import check_entry
+    from wittgenstein_tpu.protocols.pingpong_batched import BatchedPingPong
+
+    class FaultWriter(BatchedPingPong):
+        def deliver(self, net, state, deliver_mask):
+            state, em = super().deliver(net, state, deliver_mask)
+            if len(state.faults) > 0:  # only once SL407 arms the lane
+                fs = state.faults
+                state = state._replace(
+                    faults=fs._replace(
+                        dropped_by_fault=fs.dropped_by_fault + jnp.int32(1)
+                    )
+                )
+            return state, em
+
+    findings = check_entry(
+        _entry_with_protocol(FaultWriter), root=str(REPO_ROOT)
+    )
+    assert any(
+        f.rule == "SL407" and "dropped_by_fault" in f.message
+        for f in findings
+    )
+
+
 # ---------------------------------------------------------------------------
 # Whole-tree cleanliness + catalog sync
 # ---------------------------------------------------------------------------

@@ -41,6 +41,7 @@ ENGINE_HOST_METHODS = {
     "init_state",
     "cache_key",
     "with_telemetry",
+    "with_faults",
     "run_ms",
     "run_ms_batched",
     "_window",

@@ -1,4 +1,4 @@
-"""Abstract-eval contract checks (SL401-SL404).
+"""Abstract-eval contract checks (SL401-SL404, SL406-SL407).
 
 These rules run the real engine code under JAX's abstract interpreter
 instead of reading its text: every protocol registered in
@@ -25,6 +25,13 @@ SL404  recompile sentry: step() output avals (including weak_type) must
        equal input avals so chained run_ms calls hit the jit cache, and
        two independent traces must yield the same jaxpr (no
        trace-nondeterminism from unordered Python iteration).
+SL406  fault-off neutrality: a fault-enabled engine running the neutral
+       FaultState must leave every non-fault leaf's aval unchanged AND
+       one concrete step must be bit-identical (the fault twin of
+       SL403; wittgenstein_tpu.faults).
+SL407  fault-lane ownership: tracing deliver() on a fault-ENABLED
+       delivery view, every state.faults leaf must be a pure
+       passthrough — the engine owns the schedule and its counters.
 
 Protocol-level suppression: list rule ids in the protocol class's
 SIMLINT_SUPPRESS tuple (the dynamic analog of `# simlint: disable=`).
@@ -249,6 +256,125 @@ def _check_telemetry_neutral(jax, name, net, state, path, line, suppress):
     return findings
 
 
+def _check_fault_neutral(jax, name, net, state, path, line, suppress):
+    """SL406: a fault-enabled engine on the neutral schedule leaves
+    non-fault leaves bit-identical (the fault twin of SL403).  Entries
+    that are ALREADY fault-enabled (the fault-lane registry entries)
+    are skipped — their schedule is deliberately non-neutral and their
+    neutrality is covered by the base entry."""
+    import numpy as np
+
+    from ..faults.state import FaultConfig
+
+    if getattr(net, "faults", None) is not None:
+        return []
+    findings = []
+    try:
+        fnet, fstate = net.with_faults(state, FaultConfig())
+        out_plain = jax.eval_shape(net.step, state)
+        out_fault = jax.eval_shape(fnet.step, fstate)
+    except Exception as e:
+        f = _mk("SL406", path, line,
+                f"[{name}] fault instrumentation failed: "
+                f"{type(e).__name__}: {e}", suppress)
+        return [f] if f else []
+    fp_p = _fingerprint(jax, out_plain._replace(faults=()))
+    fp_f = _fingerprint(jax, out_fault._replace(faults=()))
+    diffs = _diff_fingerprints(fp_p, fp_f)
+    for d in diffs[:_MAX_LEAF_REPORTS]:
+        f = _mk("SL406", path, line,
+                f"[{name}] fault side-car changes a non-fault leaf aval: "
+                f"{d}", suppress)
+        if f:
+            findings.append(f)
+    if diffs:
+        return findings
+
+    # concrete one-step cross-check: the neutral schedule must be
+    # bit-neutral (every fault predicate constant-false, every latency
+    # an exact passthrough)
+    s_plain = net.step(state)
+    s_fault = fnet.step(fstate)
+    for (p, a), (_, b) in zip(
+        _leaf_paths(jax, s_plain._replace(faults=())),
+        _leaf_paths(jax, s_fault._replace(faults=())),
+    ):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            f = _mk("SL406", path, line,
+                    f"[{name}] neutral fault schedule perturbs sim "
+                    f"dynamics: leaf {p} differs bitwise after one "
+                    "fault-enabled step", suppress)
+            if f:
+                findings.append(f)
+            break
+    return findings
+
+
+def _check_fault_deliver_ownership(jax, name, net, state, path, line, suppress):
+    """SL407: deliver() must leave the fault lane alone, checked on a
+    fault-ENABLED delivery view (on a plain entry state.faults has zero
+    leaves, so SL402's ownership scan is vacuous there)."""
+    from ..engine.core import SimState
+    from ..faults.state import FaultConfig
+
+    if getattr(net, "faults", None) is None:
+        try:
+            net, state = net.with_faults(state, FaultConfig())
+        except Exception as e:
+            f = _mk("SL407", path, line,
+                    f"[{name}] fault instrumentation failed: "
+                    f"{type(e).__name__}: {e}", suppress)
+            return [f] if f else []
+    vstate, _due, deliver, _ctx = net.delivery_view(state)
+
+    def deliver_state(vs, mask):
+        pstate, _em = net.protocol.deliver(net, vs, mask)
+        return pstate
+
+    try:
+        closed, out_shape = jax.make_jaxpr(deliver_state, return_shape=True)(
+            vstate, deliver
+        )
+    except Exception as e:
+        f = _mk("SL407", path, line,
+                f"[{name}] deliver() failed tracing on the fault-enabled "
+                f"delivery view: {type(e).__name__}: {e}", suppress)
+        return [f] if f else []
+    if jax.tree_util.tree_structure(out_shape) != jax.tree_util.tree_structure(
+        vstate
+    ):
+        f = _mk("SL407", path, line,
+                f"[{name}] deliver() changes the SimState tree structure "
+                "on the fault-enabled view", suppress)
+        return [f] if f else []
+
+    offsets = {}
+    i = 0
+    for fname, sub in zip(SimState._fields, vstate):
+        n = len(jax.tree_util.tree_leaves(sub))
+        offsets[fname] = (i, i + n)
+        i += n
+    invars = closed.jaxpr.invars
+    outvars = closed.jaxpr.outvars
+    allowed = set(getattr(net.protocol, "DELIVER_MAY_TOUCH", ()) or ())
+    if "faults" in allowed:
+        return []
+    a, b = offsets["faults"]
+    touched = [k for k in range(a, b) if outvars[k] is not invars[k]]
+    if touched:
+        leaf_names = [p for p, _ in _leaf_paths(jax, vstate.faults)]
+        names = ", ".join(
+            leaf_names[k - a] if k - a < len(leaf_names) else f"leaf {k - a}"
+            for k in touched[:_MAX_LEAF_REPORTS]
+        )
+        f = _mk("SL407", path, line,
+                f"[{name}] deliver() writes the fault lane "
+                f"(state.faults leaves not passed through: {names}); the "
+                "engine owns the fault schedule and its counters", suppress)
+        return [f] if f else []
+    return []
+
+
 def _check_recompile(jax, name, net, state, out_shape, path, line, suppress):
     """SL404: step output avals == input avals (jit-cache stability) and
     trace determinism."""
@@ -285,8 +411,9 @@ def _check_recompile(jax, name, net, state, out_shape, path, line, suppress):
 
 
 def check_entry(entry, root: str = ".") -> List[Finding]:
-    """Run SL401-SL404 for one registry entry; [] when clean or when the
-    entry opts out of contract checks (standalone engines)."""
+    """Run SL401-SL404 + SL406-SL407 for one registry entry; [] when
+    clean or when the entry opts out of contract checks (standalone
+    engines)."""
     jax = _cpu_jax()
     if not entry.contract_checks:
         return []
@@ -305,6 +432,12 @@ def check_entry(entry, root: str = ".") -> List[Finding]:
         jax, entry.name, net, state, path, line, suppress
     )
     findings += _check_telemetry_neutral(
+        jax, entry.name, net, state, path, line, suppress
+    )
+    findings += _check_fault_neutral(
+        jax, entry.name, net, state, path, line, suppress
+    )
+    findings += _check_fault_deliver_ownership(
         jax, entry.name, net, state, path, line, suppress
     )
     findings += _check_recompile(

@@ -61,6 +61,10 @@ RULES: Dict[str, str] = {
     "cache (output avals drift or trace is not reproducible)",
     "SL405": "RNG-stream audit: tick_beat's latency_arrivals draw count "
     "does not match the declared BEAT_SEND_CALLS",
+    "SL406": "fault side-car is not neutral when idle: a fault-enabled "
+    "engine on the neutral schedule perturbs non-fault state",
+    "SL407": "deliver() writes the fault lane: state.faults leaves must "
+    "be pure passthroughs on a fault-enabled delivery view",
 }
 
 

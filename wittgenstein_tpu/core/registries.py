@@ -220,6 +220,26 @@ def _make_p2pflood_small():
     return make_p2pflood(P2PFloodParameters(), capacity=2048)
 
 
+def _make_p2pflood_faults_small():
+    # the fault-LANE contract entry: same protocol/scale as "p2pflood"
+    # but with the fault engine armed and a non-neutral schedule, so
+    # simlint traces deliver/step against a state that actually carries
+    # fault leaves (SL402/SL407 on the plain entry would be vacuous —
+    # zero fault leaves to check ownership of)
+    from ..faults import FaultConfig, FaultPlan
+    from ..protocols.p2pflood import P2PFloodParameters
+    from ..protocols.p2pflood_batched import make_p2pflood
+
+    net, state = make_p2pflood(P2PFloodParameters(), capacity=2048)
+    plan = (
+        FaultPlan("contract")
+        .crash(range(20, 30), at=200, recover=900)
+        .drop(100, start=100)
+        .inflate(1500, add_ms=5, start=100, end=800)
+    )
+    return net.with_faults(state, FaultConfig(), plan)
+
+
 def _make_paxos_small():
     from ..protocols.paxos import PaxosParameters
     from ..protocols.paxos_batched import make_paxos
@@ -387,6 +407,13 @@ def _make_ethpow_small():
 
 _reg("pingpong", "pingpong_batched", _make_pingpong_small)
 _reg("p2pflood", "p2pflood_batched", _make_p2pflood_small)
+_reg(
+    "p2pflood_faults",
+    "p2pflood_batched",
+    _make_p2pflood_faults_small,
+    note="fault-injection lane (wittgenstein_tpu.faults) traced on the "
+    "p2pflood kernels; exercises SL406/SL407 on a non-neutral schedule",
+)
 _reg("paxos", "paxos_batched", _make_paxos_small)
 _reg("slush", "avalanche_batched", _make_slush_small)
 _reg("snowflake", "avalanche_batched", _make_snowflake_small)

@@ -50,6 +50,13 @@ import numpy as np
 from jax import lax
 
 from ..core.latency import LatencyStatic, NetworkLatency, vec_latency
+from ..faults.state import (
+    FaultConfig,
+    deliver_suppress,
+    inflate_latency,
+    neutral_fault_state,
+    send_suppress,
+)
 from ..ops.bitops import lowest_set_bit, pack_bool_words, popcount_words
 from ..telemetry.state import (
     TelemetryConfig,
@@ -117,6 +124,12 @@ class SimState(NamedTuple):
     # of pure counters otherwise — never read by sim dynamics, so an
     # instrumented run is bit-identical in every other field
     tele: Any = ()
+    # fault side-car: () when the engine's FaultConfig is unset, a
+    # faults.FaultState schedule + counters otherwise.  Unlike tele it IS
+    # read by sim dynamics (that is its job) — but the neutral schedule
+    # makes every fault predicate constant-false, so a fault-enabled run
+    # on neutral_fault_state is bit-identical too (simlint SL406)
+    faults: Any = ()
 
 
 @dataclasses.dataclass
@@ -167,6 +180,7 @@ class BatchedNetwork:
         wheel_slots: Optional[int] = None,
         overflow_capacity: Optional[int] = None,
         telemetry: Optional[TelemetryConfig] = None,
+        faults: Optional["FaultConfig"] = None,
     ):
         self.protocol = protocol
         self.latency = latency
@@ -178,6 +192,10 @@ class BatchedNetwork:
         # (state.tele is an empty pytree); a TelemetryConfig threads the
         # counter side-car through every send/deliver/jump site below
         self.telemetry = telemetry
+        # STATIC switch for the fault-injection lanes (faults/state.py),
+        # same pattern: None leaves state.faults an empty pytree and the
+        # two choke points below trace zero fault ops
+        self.faults = faults
         self.payload_width = protocol.PAYLOAD_WIDTH
         sizes = [protocol.msg_size(t) for t in range(protocol.n_msg_types())]
         self._msg_sizes = np.asarray(sizes, dtype=np.int32)
@@ -218,9 +236,22 @@ class BatchedNetwork:
     # -- state construction (host-side) -------------------------------------
     def init_state(self, cols: dict, seed: int, proto: Any, down=None) -> SimState:
         """Build a fresh single-replica state from node columns
-        (core.node.build_node_columns output).  `down` marks nodes dead from
-        t=0 — applied before the protocol's initial emissions so sends to
-        them are dropped like the oracle's send-time check."""
+        (core.node.build_node_columns output).
+
+        `down` (bool[N], default all-up) marks nodes dead for the WHOLE
+        run — the batched twin of the oracle nodes `choose_bad_nodes`
+        selects, which `Network.run_ms` never start()s.  Because the mask
+        is set before the protocol's initial emissions are applied, a
+        down node (a) never sends: its initial and later emissions fail
+        `latency_arrivals`' send-time check, exactly like the oracle's
+        `from_node.is_down()` (Network.java:476-487) — though msg_sent
+        still ticks for the *attempts other protocols make toward it*,
+        never for its own, since a node that receives nothing emits
+        nothing; (b) never receives: the delivery view discards due rows
+        addressed to it (Network.java:606); and (c) never reaches
+        done_at > 0, so done counts and CDFs exclude it.  Pinned
+        cross-protocol by tests/test_faults.py::test_statically_down_nodes.
+        For crash/recovery *during* a run, see wittgenstein_tpu.faults."""
         n, p = self.n_nodes, self.payload_width
         w, b, v = self.wheel_rows, self.wheel_slots, self.overflow_capacity
         zi = lambda shape: jnp.zeros(shape, dtype=jnp.int32)
@@ -264,6 +295,11 @@ class BatchedNetwork:
                 if self.telemetry is not None
                 else ()
             ),
+            faults=(
+                neutral_fault_state(n, self.protocol.n_msg_types())
+                if self.faults is not None
+                else ()
+            ),
         )
         for em in self.protocol.initial_emissions(self, state):
             state = self.apply_emission(state, em)
@@ -292,6 +328,7 @@ class BatchedNetwork:
             getattr(self, "node_axis", None),
             id(mesh) if mesh is not None else None,
             self.telemetry.key() if self.telemetry is not None else None,
+            self.faults.key() if self.faults is not None else None,
         )
 
     def with_telemetry(
@@ -328,6 +365,37 @@ class BatchedNetwork:
         ).sum(-2)
         tele = tele._replace(sent=(in_wheel + in_ovf).astype(jnp.int32))
         return net, state._replace(tele=tele)
+
+    def with_faults(
+        self, state: SimState, faults: "FaultConfig | None" = None, plan=None
+    ) -> "tuple[BatchedNetwork, SimState]":
+        """Arm fault injection on an ALREADY-BUILT simulation: returns an
+        engine copy carrying the (static) FaultConfig and the state with
+        a FaultState side-car attached.  `plan` may be a host-side
+        FaultPlan (lowered here), an already-lowered FaultState — e.g. a
+        `lower_plans` stack for a per-replica heterogeneous sweep — or
+        None for the neutral do-nothing schedule.  Works on single and
+        batched states: an unstacked schedule broadcasts over the
+        leading replica axes; a pre-stacked one is used as-is."""
+        import copy
+
+        from ..faults.state import FaultConfig, FaultState
+
+        net = copy.copy(self)
+        net.faults = FaultConfig() if faults is None else faults
+        t = self.protocol.n_msg_types()
+        if plan is None:
+            fs = neutral_fault_state(self.n_nodes, t)
+        elif isinstance(plan, FaultState):
+            fs = plan
+        else:
+            fs = plan.lower(self.n_nodes, t)
+        lead = tuple(jnp.shape(state.time))
+        if lead and jnp.ndim(fs.crash_at) < 1 + len(lead):
+            fs = jax.tree_util.tree_map(
+                lambda a: jnp.broadcast_to(a, lead + tuple(jnp.shape(a))), fs
+            )
+        return net, state._replace(faults=fs)
 
     # -- partitions (Network.partition, Network.java:693-707) ----------------
     @staticmethod
@@ -395,6 +463,44 @@ class BatchedNetwork:
             & (pid_f == pid_t)
             & (lat < self.msg_discard_time)
         )
+        if self.faults is not None:
+            # fault choke point 1 (send): crash/partition/silence/drop
+            # suppress rows AFTER the counters ticked above (the oracle
+            # ticks msg_sent before its down check too), and the
+            # inflation/Byzantine-delay lanes rewrite the sampled
+            # latency.  With the neutral schedule supp is constant-false
+            # and lat_f == lat, so ok/arrival are bit-identical — the
+            # SL406 contract.  The drop draw uses its own hash32 stream
+            # without advancing send_ctr, leaving base RNG untouched.
+            fs = state.faults
+            mrows = jnp.broadcast_to(mtype, mask.shape).astype(jnp.int32)
+            lat_f = inflate_latency(
+                self.faults, fs, state.time, from_idx, mrows, lat
+            )
+            supp = send_suppress(
+                self.faults, fs, state.time, from_idx, to_idx, mrows,
+                state.seed, state.send_ctr, send_time,
+            )
+            ok_f = (
+                mask
+                & ~state.down[from_idx]
+                & ~state.down[to_idx]
+                & (pid_f == pid_t)
+                & ~supp
+                & (lat_f < self.msg_discard_time)
+            )
+            state = state._replace(
+                faults=fs._replace(
+                    dropped_by_fault=count_by_type(
+                        fs.dropped_by_fault, ok & supp, mrows
+                    ),
+                    delayed_by_fault=count_by_type(
+                        fs.delayed_by_fault, ok_f & (lat_f != lat), mrows
+                    ),
+                )
+            )
+            ok = ok_f
+            arrival = jnp.asarray(send_time, jnp.int32) + lat_f
         if self.telemetry is not None:
             # the latency kernel is the one choke point EVERY send crosses
             # (generic store and the agg protocols' channel commits alike),
@@ -603,6 +709,20 @@ class BatchedNetwork:
         pid_f = self.partition_id(state, state.x[view_from])
         pid_t = self.partition_id(state, state.x[view_to])
         deliver = due & ~state.down[view_to] & (pid_f == pid_t)
+        if self.faults is not None:
+            # fault choke point 2 (arrival): suppress delivery to
+            # fault-crashed destinations and across an active group
+            # partition.  Recovery needs no extra work — the crash
+            # predicate simply stops holding at recover_at.  The
+            # suppression mask rides in ctx so _deliver_and_clear can
+            # count the rows; they still leave the store like any other
+            # due row (the store invariant is fault-agnostic).
+            fault_supp = due & deliver_suppress(
+                self.faults, state.faults, t, view_from, view_to
+            )
+            deliver = deliver & ~fault_supp
+        else:
+            fault_supp = None
 
         vstate = state._replace(
             msg_valid=view_valid,
@@ -612,7 +732,7 @@ class BatchedNetwork:
             msg_type=view_type,
             msg_payload=view_payload,
         )
-        ctx = (rows, wv, wa, wf, wt, wk, wp, q, b)
+        ctx = (rows, wv, wa, wf, wt, wk, wp, q, b, fault_supp)
         return vstate, due, deliver, ctx
 
     def _deliver_and_clear(self, state: SimState):
@@ -621,7 +741,7 @@ class BatchedNetwork:
         then clear delivered entries and repack the visited rows to a dense
         prefix.  Returns (state, emissions)."""
         vview, due, deliver, ctx = self.delivery_view(state)
-        rows, wv, wa, wf, wt, wk, wp, q, b = ctx
+        rows, wv, wa, wf, wt, wk, wp, q, b, fault_supp = ctx
         view_to = vview.msg_to
         view_type = vview.msg_type
 
@@ -646,6 +766,18 @@ class BatchedNetwork:
                     discarded=count_by_type(
                         tele.discarded, due & ~deliver, view_type
                     ),
+                )
+            )
+        if self.faults is not None:
+            # delivery-time fault discards (crashed destination / active
+            # partition window); telemetry already folded them into
+            # `discarded` above, this is the per-lane attribution
+            fs = state.faults
+            state = state._replace(
+                faults=fs._replace(
+                    dropped_by_fault=count_by_type(
+                        fs.dropped_by_fault, fault_supp, view_type
+                    )
                 )
             )
 

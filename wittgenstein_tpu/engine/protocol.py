@@ -45,6 +45,7 @@ ENGINE_OWNED_FIELDS = (
     "msg_head",
     "dropped",
     "tele",
+    "faults",
 )
 
 # Hooks traced under jit (tracer-safety rules apply) vs host-side

@@ -1,0 +1,42 @@
+"""Vectorized fault injection for the batched engine.
+
+A host-side `FaultPlan` (crash/recover windows, group partitions,
+per-mtype drop probability, latency inflation, Byzantine silence/delay)
+lowers into a struct-of-arrays `FaultState` side-car on `SimState`,
+per-replica heterogeneous, injected in-graph at the engine's two choke
+points (`latency_arrivals` and the delivery view) behind a static
+`FaultConfig` flag — bit-identical to a fault-free engine when off.
+See docs/faults.md.
+"""
+
+from .oracle_hooks import crash_edges, run_ms_with_plan, start_nodes, stop_nodes
+from .plan import FaultPlan, lower_plans
+from .state import (
+    FAULT_STREAM,
+    FaultConfig,
+    FaultState,
+    deliver_suppress,
+    inflate_latency,
+    neutral_fault_state,
+    node_crashed,
+    send_suppress,
+    stack_fault_states,
+)
+
+__all__ = [
+    "FAULT_STREAM",
+    "FaultConfig",
+    "FaultPlan",
+    "FaultState",
+    "crash_edges",
+    "deliver_suppress",
+    "inflate_latency",
+    "lower_plans",
+    "neutral_fault_state",
+    "node_crashed",
+    "run_ms_with_plan",
+    "send_suppress",
+    "stack_fault_states",
+    "start_nodes",
+    "stop_nodes",
+]

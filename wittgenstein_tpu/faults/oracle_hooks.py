@@ -1,0 +1,83 @@
+"""Oracle (Java-faithful DES) twins of the batched crash lane.
+
+The oracle's `Node.start()/stop()` just flip `_down`, so a crash/recover
+schedule is a sequence of run segments with stop/start calls between
+them.  These helpers chop `Network.run_ms` so a batched `FaultPlan`'s
+crash windows replay exactly on the oracle, which is how parity tests
+pin the fault lane's done-at CDF (tests/test_faults.py).
+
+Alignment with the batched predicate `crashed(t) = crash_at <= t <
+recover_at` (see faults/state.py):
+
+  * the oracle is run through tick `crash_at - 1` BEFORE stop() — sends
+    executed while processing tick crash_at-1 (send_time crash_at) are
+    accepted in both implementations, because the batched send check
+    evaluates the crash at the CURRENT tick, not at send_time;
+  * deliveries at tick crash_at and later are dropped by the oracle's
+    delivery-time `is_down()` check and by the batched delivery view;
+  * start() lands the same way at recover_at.
+
+Only the crash lane has an oracle twin: partitions on the oracle are
+x-threshold based (`Network.partition`) and already parity-tested, and
+the probabilistic drop / inflation / Byzantine lanes are batched-RNG
+constructs with no Java counterpart.  `run_ms_with_plan` raises on
+plans using those lanes rather than silently ignoring them.
+
+Caveat: a `crash(at=0)` plan is NOT the oracle's never-started node —
+the oracle skips start() (so no initial sends attempt, msg_sent==0)
+while the batched engine suppresses the initial emissions but still
+ticks sender counters.  Nodes dead from t=0 belong in
+`init_state(down=...)` / the node builder's down set, which both sides
+treat identically.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+from .state import INT_MAX
+
+
+def stop_nodes(network, ids: Iterable[int]) -> None:
+    for i in ids:
+        network.all_nodes[i].stop()
+
+
+def start_nodes(network, ids: Iterable[int]) -> None:
+    for i in ids:
+        network.all_nodes[i].start()
+
+
+def crash_edges(plan) -> List[Tuple[int, str, tuple]]:
+    """[(tick, 'stop'|'start', node ids)] from a FaultPlan's crash lane;
+    raises if the plan uses lanes the oracle cannot replay."""
+    for lane in ("_partition", "_drop", "_inflate", "_silence", "_delay"):
+        if getattr(plan, lane) is not None:
+            raise ValueError(
+                f"plan '{plan.label}' uses {lane.lstrip('_')}(): only the "
+                "crash lane has an oracle twin (x-partitions go through "
+                "Network.partition directly)"
+            )
+    edges: List[Tuple[int, str, tuple]] = []
+    for nodes, at, recover in plan._crashes:
+        edges.append((at, "stop", nodes))
+        if recover < int(INT_MAX):
+            edges.append((recover, "start", nodes))
+    edges.sort(key=lambda e: e[0])
+    return edges
+
+
+def run_ms_with_plan(network, plan, sim_ms: int):
+    """Run the oracle to `sim_ms` replaying the plan's crash windows at
+    the batched engine's tick alignment (see module docstring).  The
+    network must be freshly initialised (time 0)."""
+    for tick, kind, nodes in crash_edges(plan):
+        if tick > sim_ms:
+            break
+        pre = tick - 1  # last tick the old up/down state applies to
+        if pre > network.time:
+            network.run_ms(pre - network.time)
+        (stop_nodes if kind == "stop" else start_nodes)(network, nodes)
+    if sim_ms > network.time:
+        network.run_ms(sim_ms - network.time)
+    return network

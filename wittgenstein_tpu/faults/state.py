@@ -1,0 +1,238 @@
+"""In-graph fault state: the device-side fault-injection tier.
+
+The reference simulator treats adversity as first-class — down nodes
+(NodeBuilder), Byzantine senders (Casper's ByzBlockProducer, Handel's
+suppression scenarios), degraded WANs — but only through host-side Java
+objects mutated between `run_ms` calls.  On the batched engine a fault
+schedule must live INSIDE the compiled program so `run_ms_batched` can
+sweep fault scenarios the way it already sweeps seeds: the schedule is a
+`FaultState` pytree side-car on `SimState`, per-replica heterogeneous
+(every leaf grows the leading replica axis under vmap like any other
+state column).
+
+Lanes, all windowed on sim time `t` with the convention
+`active(t) = start <= t < end` (end exclusive; INT_MAX start = never):
+
+  * crash/recovery per node: `crashed(i, t) = crash_at[i] <= t <
+    recover_at[i]`.  A crashed node's sends are suppressed at the
+    latency kernel (the oracle's send-time `is_down()` check,
+    Network.java:476-487) and deliveries TO it are suppressed at the
+    delivery view (Network.java:606); messages already in flight from
+    it still arrive, exactly like the oracle.  Sender counters still
+    tick for suppressed sends (the oracle ticks msg_sent before its
+    down check).  Recovery is just the window end: from `recover_at`
+    the node sends and receives again.
+  * group partition: a node->group map plus one window; cross-group
+    messages are suppressed at send AND at delivery (a message sent
+    before the window but arriving inside it is dropped on arrival,
+    mirroring the oracle's delivery-time partition re-check).
+  * per-mtype probabilistic drop: drop_pm[T] per-mille, drawn from a
+    dedicated `hash32` stream salted with FAULT_STREAM — the engine's
+    send_ctr is NOT advanced, so the base RNG sequence (and therefore
+    every fault-free latency draw) is untouched.
+  * per-mtype latency inflation: arrival' = send_time +
+    (lat * infl_pm[T]) // 1000 + infl_add[T] inside the window.
+  * Byzantine masks: byz_silent[N] senders emit nothing inside the
+    window (counters still tick); byz_delay[N] adds a per-sender
+    constant to every outgoing latency.
+
+Neutrality is the contract (simlint SL406, tests/test_faults.py): with
+the neutral `FaultState` every predicate above is constant-false and
+every latency passes through `jnp.where` unchanged, so a fault-enabled
+run is bit-identical in all non-fault fields to a disabled one.  The
+enable switch is STATIC (`FaultConfig` on the engine, part of its jit
+cache key): disabled engines carry `faults=()` — an empty pytree, zero
+leaves, zero traced ops, the exact pattern of the telemetry side-car.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+INT_MAX = np.int32(2**31 - 1)
+
+# salt for the drop-draw hash32 stream: decorrelates fault draws from the
+# latency draws that share (seed, send_time, from, mtype, send_ctr, to)
+FAULT_STREAM = np.int32(0x5AFE)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Static fault-lane switches; hashable, stamped into the engine's
+    cache_key (a different config is a different traced program).  Each
+    flag prunes its lane's ops from the trace entirely — an engine with
+    only `crashes=True` pays nothing for the drop/inflation RNG."""
+
+    crashes: bool = True
+    partitions: bool = True
+    drops: bool = True
+    delays: bool = True  # latency inflation lane
+    byzantine: bool = True  # silence + per-sender delay masks
+
+    def __post_init__(self):
+        if not any(
+            (self.crashes, self.partitions, self.drops, self.delays,
+             self.byzantine)
+        ):
+            raise ValueError(
+                "FaultConfig with every lane disabled traces zero fault "
+                "ops; pass faults=None to the engine instead"
+            )
+
+    def key(self) -> tuple:
+        return (self.crashes, self.partitions, self.drops, self.delays,
+                self.byzantine)
+
+
+class FaultState(NamedTuple):
+    """The fault-schedule side-car (int32/bool; leading replica axis
+    appears under vmap exactly like every other SimState leaf).
+    [N] = one row per node, [T] = one row per protocol message type;
+    window scalars are int32 with INT_MAX = never active."""
+
+    # crash lane [N]: crashed(i, t) = crash_at[i] <= t < recover_at[i]
+    crash_at: jnp.ndarray
+    recover_at: jnp.ndarray
+    # partition lane: group map [N] + one active window
+    group: jnp.ndarray
+    part_start: jnp.ndarray
+    part_end: jnp.ndarray
+    # probabilistic drop lane [T] (per-mille) + window
+    drop_pm: jnp.ndarray
+    drop_start: jnp.ndarray
+    drop_end: jnp.ndarray
+    # latency-inflation lane [T]: lat' = lat * infl_pm // 1000 + infl_add
+    infl_pm: jnp.ndarray
+    infl_add: jnp.ndarray
+    infl_start: jnp.ndarray
+    infl_end: jnp.ndarray
+    # Byzantine lane [N] + window
+    byz_silent: jnp.ndarray  # bool[N]: sender emits nothing in-window
+    byz_delay: jnp.ndarray  # int32[N]: flat ms added to outgoing latency
+    byz_start: jnp.ndarray
+    byz_end: jnp.ndarray
+    # fault counters [T] (pure accounting, like the telemetry tier)
+    dropped_by_fault: jnp.ndarray  # sends/deliveries a fault suppressed
+    delayed_by_fault: jnp.ndarray  # sends whose latency a fault changed
+
+
+def neutral_fault_state(n_nodes: int, n_msg_types: int) -> FaultState:
+    """The do-nothing schedule: every window starts at INT_MAX, drop
+    probability 0, inflation multiplier 1000 (identity).  A fault-enabled
+    engine running this state is bit-identical to a disabled one (pinned
+    by tests/test_faults.py and simlint SL406)."""
+    n, t = n_nodes, n_msg_types
+    never = lambda: jnp.asarray(INT_MAX, jnp.int32)
+    return FaultState(
+        crash_at=jnp.full(n, INT_MAX, dtype=jnp.int32),
+        recover_at=jnp.full(n, INT_MAX, dtype=jnp.int32),
+        group=jnp.zeros(n, dtype=jnp.int32),
+        part_start=never(),
+        part_end=never(),
+        drop_pm=jnp.zeros(t, dtype=jnp.int32),
+        drop_start=never(),
+        drop_end=never(),
+        infl_pm=jnp.full(t, 1000, dtype=jnp.int32),
+        infl_add=jnp.zeros(t, dtype=jnp.int32),
+        infl_start=never(),
+        infl_end=never(),
+        byz_silent=jnp.zeros(n, dtype=bool),
+        byz_delay=jnp.zeros(n, dtype=jnp.int32),
+        byz_start=never(),
+        byz_end=never(),
+        dropped_by_fault=jnp.zeros(t, dtype=jnp.int32),
+        delayed_by_fault=jnp.zeros(t, dtype=jnp.int32),
+    )
+
+
+def stack_fault_states(states) -> FaultState:
+    """Stack per-replica schedules along a new leading axis — the fault
+    analog of engine.core.stack_states, for heterogeneous sweeps."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+
+# -- in-graph predicates (called from the engine's two choke points) ---------
+def window_active(start, end, t):
+    return (start <= t) & (t < end)
+
+
+def node_crashed(fs: FaultState, idx, t):
+    return (fs.crash_at[idx] <= t) & (t < fs.recover_at[idx])
+
+
+def send_suppress(
+    cfg: FaultConfig, fs: FaultState, t, from_idx, to_idx, mtype_rows,
+    seed, send_ctr, send_time,
+):
+    """bool[K]: rows the fault lanes kill at the latency kernel.  The
+    crash predicate is evaluated at the CURRENT tick `t` (not at
+    send_time): the oracle executes a send during the tick that emits
+    it, so forwards emitted while processing tick t carry send_time t+1
+    but are accepted as long as the sender is alive AT t."""
+    supp = jnp.zeros(jnp.shape(from_idx), dtype=bool)
+    if cfg.crashes:
+        # both endpoints, like the oracle's send-time is_down() pair
+        supp = supp | node_crashed(fs, from_idx, t) | node_crashed(fs, to_idx, t)
+    if cfg.partitions:
+        cross = fs.group[from_idx] != fs.group[to_idx]
+        supp = supp | (window_active(fs.part_start, fs.part_end, t) & cross)
+    if cfg.byzantine:
+        supp = supp | (
+            window_active(fs.byz_start, fs.byz_end, t) & fs.byz_silent[from_idx]
+        )
+    if cfg.drops:
+        from ..engine.rng import hash32
+
+        # dedicated stream: salting with FAULT_STREAM (and NOT advancing
+        # send_ctr) leaves every base latency draw untouched, so drop_pm=0
+        # rows are bit-identical to a fault-free run
+        u = hash32(
+            seed, jnp.asarray(FAULT_STREAM, jnp.int32), send_time, from_idx,
+            mtype_rows, send_ctr, to_idx,
+        ).astype(jnp.uint32)
+        draw = (u % jnp.uint32(1000)).astype(jnp.int32)
+        supp = supp | (
+            window_active(fs.drop_start, fs.drop_end, t)
+            & (draw < fs.drop_pm[mtype_rows])
+        )
+    return supp
+
+
+def inflate_latency(
+    cfg: FaultConfig, fs: FaultState, t, from_idx, mtype_rows, lat
+):
+    """int32[K]: the sampled latency after the inflation and Byzantine
+    delay lanes.  Outside their windows both are exact passthroughs
+    (jnp.where picks the untouched value), preserving bit-identity."""
+    new = lat
+    if cfg.delays:
+        act = window_active(fs.infl_start, fs.infl_end, t)
+        inflated = (lat * fs.infl_pm[mtype_rows]) // jnp.int32(1000) + (
+            fs.infl_add[mtype_rows]
+        )
+        new = jnp.where(act, inflated, new)
+    if cfg.byzantine:
+        bact = window_active(fs.byz_start, fs.byz_end, t)
+        new = new + jnp.where(bact, fs.byz_delay[from_idx], jnp.int32(0))
+    return new
+
+
+def deliver_suppress(cfg: FaultConfig, fs: FaultState, t, view_from, view_to):
+    """bool[D]: due rows the fault lanes discard on arrival.  Only the
+    destination's crash state matters here (a message in flight from a
+    node that crashed after sending still arrives, like the oracle);
+    the partition lane re-checks on arrival like the oracle's
+    delivery-time partition test (Network.java:606)."""
+    supp = jnp.zeros(jnp.shape(view_to), dtype=bool)
+    if cfg.crashes:
+        supp = supp | node_crashed(fs, view_to, t)
+    if cfg.partitions:
+        cross = fs.group[view_from] != fs.group[view_to]
+        supp = supp | (window_active(fs.part_start, fs.part_end, t) & cross)
+    return supp

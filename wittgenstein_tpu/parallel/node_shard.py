@@ -105,6 +105,10 @@ _MESSAGE_STORE_FIELDS = (
     # telemetry side-car: counter rows are mtype-/window-indexed, never
     # node-indexed — replicate even if a dimension coincides with n_nodes
     ".tele",
+    # fault side-car: node-column lanes gather by from/to index, so a
+    # replicated copy is correct everywhere, and the counter rows are
+    # mtype-indexed like telemetry — replicate the whole schedule
+    ".faults",
 )
 
 

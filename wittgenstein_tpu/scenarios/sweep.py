@@ -197,6 +197,86 @@ def run_sweep(
     return [results[i] for i in range(len(configs))]
 
 
+def run_fault_sweep(
+    net,
+    state,
+    plans: list,
+    sim_ms: int,
+    replicas_per_plan: int = 1,
+    faults=None,
+    seed0: int = 0,
+    stop_when_done: bool = False,
+    done_cdf_every: int = 0,
+):
+    """The fault-axis sweep: one `run_ms_batched` call where replica row
+    `r` runs fault plan `plans[r // replicas_per_plan]` (None entries =
+    fault-free control rows).  Takes any built (net, state) — the fault
+    lanes are protocol-agnostic — and returns (out, records): the final
+    stacked state plus one JSON-friendly record per plan with
+    availability (done fraction of statically-live nodes), done-at
+    quantiles over done nodes, and the per-plan fault counters.
+
+    Every plan shares ONE compiled program: the schedules are data
+    (FaultState rows), not traced branches, so sweeping crash vs
+    partition vs drop costs one jit like sweeping seeds does."""
+    from ..engine.core import replicate_state
+    from ..faults import FaultConfig
+    from ..faults.plan import lower_plans
+
+    if not plans:
+        raise ValueError("run_fault_sweep needs at least one plan")
+    rpp = int(replicas_per_plan)
+    if rpp < 1:
+        raise ValueError(f"replicas_per_plan={rpp} must be >= 1")
+    fnet, fstate = net.with_faults(state, faults or FaultConfig())
+    n_rep = len(plans) * rpp
+    fs = lower_plans(
+        [p for p in plans for _ in range(rpp)],
+        net.n_nodes,
+        net.protocol.n_msg_types(),
+    )
+    batched = replicate_state(
+        fstate, n_rep, seeds=np.arange(seed0, seed0 + n_rep, dtype=np.int64)
+    )._replace(faults=fs)
+    out = fnet.run_ms_batched(batched, sim_ms, stop_when_done)
+
+    done = np.asarray(out.done_at)
+    down = np.asarray(out.down)
+    dropped = np.asarray(out.faults.dropped_by_fault)
+    delayed = np.asarray(out.faults.delayed_by_fault)
+    records = []
+    for i, plan in enumerate(plans):
+        sl = slice(i * rpp, (i + 1) * rpp)
+        live = ~down[sl]
+        d = done[sl][live]
+        fin = d[d > 0]
+        rec = {
+            "plan": (
+                {"label": "control"} if plan is None else plan.describe()
+            ),
+            "replicas": rpp,
+            "live_nodes": int(live.sum()),
+            "done_nodes": int(fin.size),
+            "availability": round(float(fin.size) / max(1, live.sum()), 4),
+            "done_at_ms": (
+                {
+                    "p10": int(np.percentile(fin, 10)),
+                    "p50": int(np.percentile(fin, 50)),
+                    "p90": int(np.percentile(fin, 90)),
+                    "max": int(fin.max()),
+                }
+                if fin.size
+                else None
+            ),
+            "dropped_by_fault": dropped[sl].sum(axis=0).tolist(),
+            "delayed_by_fault": delayed[sl].sum(axis=0).tolist(),
+        }
+        if done_cdf_every:
+            rec["done_cdf"] = _host_done_cdf(done[sl], sim_ms, done_cdf_every)
+        records.append(rec)
+    return out, records
+
+
 def default_params(
     nodes: int,
     dead_ratio: Optional[float] = None,

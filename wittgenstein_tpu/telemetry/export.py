@@ -128,6 +128,12 @@ def counters(net, state) -> dict:
             "jumps": ssum(tele.jumps),
             "jumped_ms": ssum(tele.jumped_ms),
         }
+    if getattr(net, "faults", None) is not None:
+        fs = state.faults
+        out["faults"] = {
+            "dropped_by_fault": tsum(fs.dropped_by_fault),
+            "delayed_by_fault": tsum(fs.delayed_by_fault),
+        }
     return out
 
 
@@ -288,6 +294,24 @@ def prometheus_from_counters(c: dict, prefix: str = "witt") -> str:
         p.add("ticks_total", loop["ticks"], "executed engine ticks", "counter")
         p.add("jumps_total", loop["jumps"], "empty-ms jumps", "counter")
         p.add("jumped_ms_total", loop["jumped_ms"], "ms skipped", "counter")
+    fl = c.get("faults")
+    if fl:
+        for name, v in zip(c["mtypes"], fl["dropped_by_fault"]):
+            p.add(
+                "fault_dropped_by_type_total",
+                v,
+                "sends/deliveries suppressed by an injected fault",
+                "counter",
+                {"mtype": name},
+            )
+        for name, v in zip(c["mtypes"], fl["delayed_by_fault"]):
+            p.add(
+                "fault_delayed_by_type_total",
+                v,
+                "sends whose latency an injected fault rewrote",
+                "counter",
+                {"mtype": name},
+            )
     return p.render()
 
 
