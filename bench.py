@@ -255,46 +255,56 @@ def _setup_cache() -> None:
 SAFE_CALL_S = 60.0  # keep every device call well under the ~100 s watchdog
 
 
-def chunked_pass(compiled, states, n_chunks, budget_s, heartbeat=None):
+def chunked_pass(
+    compiled,
+    states,
+    n_chunks,
+    budget_s,
+    heartbeat=None,
+    checkpoint_dir=None,
+    run_key=None,
+    run_meta=None,
+    chunk_ms=None,
+    checkpoint_every=1,
+):
     """One budgeted chunked pass over an AOT executable — THE shared
     never-kill-mid-call loop (bench ladder + scripts/tpu_campaign.py both
-    use it; keep watchdog-safety fixes here).  Aborts BETWEEN chunks when
-    the rolling elapsed time exceeds budget_s; `heartbeat(i, chunk_s)` is
-    called after every chunk so a supervisor watching file mtime can tell
-    a long healthy pass from a wedged worker.  Returns (out, times, ok).
+    use it; keep watchdog-safety fixes here).  Since r10 it is a thin
+    wrapper over runtime.Supervisor: the sync-smallest-leaf discipline
+    (ground-truth chunk completion — block_until_ready acks while a
+    tunneled program is still queued, r4 lesson) and the between-chunks
+    budget abort live there now, and passing `checkpoint_dir` makes the
+    pass RESUMABLE — a re-invocation with the same dir + run_key picks
+    up at the last completed chunk.  Aborts BETWEEN chunks when the
+    rolling elapsed time exceeds budget_s; `heartbeat(i, chunk_s)` is
+    called after every chunk so a supervisor watching file mtime can
+    tell a long healthy pass from a wedged worker.  Returns
+    (out, times, ok) — `times` covers this invocation's chunks only.
 
-    `compiled` may be jitted with donate_argnums — the loop only ever
-    feeds each chunk's OUTPUT to the next chunk, so donation is safe here
-    and saves a full state copy per chunk.  Callers that reuse `states`
-    after the pass must hand in a disposable copy (see _fresh_states)."""
-    import jax
+    `compiled` may be jitted with donate_argnums — the supervisor only
+    ever feeds each chunk's OUTPUT to the next chunk, so donation is
+    safe here and saves a full state copy per chunk.  Callers that reuse
+    `states` after the pass must hand in a disposable copy (see
+    _fresh_states)."""
+    from wittgenstein_tpu.runtime import RetryPolicy, Supervisor
 
-    t_start = time.perf_counter()
-    times = []
-    st = states
-    # sync leaf: a single program's outputs materialize together, so a
-    # device->host readback of the SMALLEST output is ground-truth
-    # completion for the whole chunk.  block_until_ready alone is not
-    # enough over the tunneled backend: it acks while the program is
-    # still queued (observed r4: 0.01 s "chunks" followed by an
-    # unbounded silent wait), which both falsifies the timings and lets
-    # the client stack many programs onto a worker it believes is idle.
-    import numpy as np
-
-    def _sync(s):
-        leaves = jax.tree_util.tree_leaves(s)
-        np.asarray(min(leaves, key=lambda a: getattr(a, "size", 1 << 62)))
-
-    for i in range(n_chunks):
-        t1 = time.perf_counter()
-        st = compiled(st)
-        _sync(st)  # keep exactly one short program in flight
-        times.append(round(time.perf_counter() - t1, 2))
-        if heartbeat is not None:
-            heartbeat(i, times[-1])
-        if time.perf_counter() - t_start > budget_s and i < n_chunks - 1:
-            return st, times, False
-    return st, times, True
+    sup = Supervisor(
+        compiled,
+        states,
+        n_chunks=n_chunks,
+        chunk_ms=chunk_ms or CHUNK_MS,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        retry=RetryPolicy(max_attempts=1),  # bench fails fast; the
+        # ladder's parent decides whether a rung is worth retrying
+        run_key=run_key,
+        run_meta=run_meta,
+        heartbeat=heartbeat,
+        budget_s=budget_s,
+        consume_template=True,
+    )
+    rep = sup.run()
+    return rep.state, [round(t, 2) for t in rep.chunk_seconds], rep.ok
 
 
 def bench_batched(node_ct: int, n_replicas: int, budget_s: float = 1e9) -> dict:
@@ -481,6 +491,68 @@ def phase_profile(
     }
 
 
+def overhead_check(
+    node_ct: int = 256, n_replicas: int = 4, repeats: int = 3
+) -> dict:
+    """Supervisor overhead on the CPU ladder rung: the same compiled
+    chunk schedule run (a) as a bare python loop with the readback sync
+    and (b) through chunked_pass/Supervisor.  min-of-repeats on both
+    sides; the supervised loop must stay within 2% of raw (the ISSUE-6
+    acceptance bound — the floor check guards it continuously)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from wittgenstein_tpu.engine import replicate_state
+    from wittgenstein_tpu.protocols.handel_batched import make_handel
+
+    _setup_cache()
+    net, state = make_handel(_params(node_ct))
+    states = replicate_state(state, n_replicas)
+    chunk_ms = CHUNK_MS
+    n_chunks = max(1, SIM_MS // chunk_ms)
+    run = jax.jit(
+        lambda s: net.run_ms_batched(s, chunk_ms, True), donate_argnums=(0,)
+    )
+    compiled = run.lower(states).compile()
+
+    def fresh():
+        return jax.tree_util.tree_map(jnp.copy, states)
+
+    def raw_pass() -> float:
+        st = fresh()
+        t0 = time.perf_counter()
+        for _ in range(n_chunks):
+            st = compiled(st)
+            leaves = jax.tree_util.tree_leaves(st)
+            np.asarray(min(leaves, key=lambda a: getattr(a, "size", 1 << 62)))
+        return time.perf_counter() - t0
+
+    def supervised_pass() -> float:
+        st = fresh()
+        t0 = time.perf_counter()
+        _, _, ok = chunked_pass(compiled, st, n_chunks, 1e9)
+        assert ok
+        return time.perf_counter() - t0
+
+    raw_pass(), supervised_pass()  # warm both paths
+    raw = min(raw_pass() for _ in range(repeats))
+    sup = min(supervised_pass() for _ in range(repeats))
+    pct = (sup - raw) / raw * 100.0
+    return {
+        "config": {
+            "node_count": node_ct,
+            "n_replicas": n_replicas,
+            "chunk_ms": chunk_ms,
+            "repeats": repeats,
+        },
+        "raw_s": round(raw, 3),
+        "supervised_s": round(sup, 3),
+        "overhead_pct": round(pct, 2),
+        "ok": pct < 2.0,
+    }
+
+
 def _run_rung(node_ct: int, n_replicas: int, budget_s: float, timeout_s: int) -> dict:
     """Run one ladder rung in a subprocess.  The child SELF-BUDGETS
     (bench_batched probes one chunk and refuses runs that don't fit
@@ -518,6 +590,77 @@ def _run_rung(node_ct: int, n_replicas: int, budget_s: float, timeout_s: int) ->
 # (pinned by test_beat_gated_run_bit_identical_to_ungated +
 # test_stop_when_done tests), but traffic counters exclude post-done
 # dissemination the oracle would still count
+# ROADMAP item-1 north star: 21 sims/s/chip at the flagship node count.
+# One sim = SIM_MS ticks, so at R replicas/batch the whole batch must
+# average R / (21 * SIM_MS) seconds per tick — the chip-independent
+# per-tick budget every rung is judged against.
+NORTH_STAR_SIMS_PER_SEC = 21.0
+
+
+def target_tick_us(n_replicas: int) -> float:
+    """Per-tick wall budget (µs) for the north-star throughput at this
+    replica count (e.g. ~6095 µs at R=128)."""
+    return n_replicas / (NORTH_STAR_SIMS_PER_SEC * SIM_MS) * 1e6
+
+
+def _floor_path() -> str:
+    return os.environ.get(
+        "WITT_BENCH_FLOOR",
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_FLOOR.json"
+        ),
+    )
+
+
+def check_cpu_floor(results) -> "dict | None":
+    """CPU-throughput floor: compare the 256x4 rung against the recorded
+    floor (BENCH_FLOOR.json); >10% below is a LOUD failure — it guards
+    both engine regressions and this file's own supervisor overhead.
+    Returns a verdict dict, or None when no comparison applies (no floor
+    recorded, different core count, rung not measured)."""
+    path = _floor_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            floor_rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    rung = next(
+        (
+            r
+            for n, rr, r in results
+            if n == floor_rec.get("node_count", 256)
+            and rr == floor_rec.get("n_replicas", 4)
+            and "sims_per_sec" in r
+        ),
+        None,
+    )
+    if rung is None:
+        return None
+    if floor_rec.get("host_cpus") != os.cpu_count():
+        # CPU numbers are only comparable at equal core counts (the r5
+        # multi-core vs r6 1-core lesson baked into _headline.config)
+        return {
+            "floor": floor_rec.get("floor"),
+            "verdict": "skipped",
+            "reason": (
+                f"floor recorded on {floor_rec.get('host_cpus')} cpus, "
+                f"this host has {os.cpu_count()}"
+            ),
+        }
+    floor = float(floor_rec["floor"])
+    val = float(rung["sims_per_sec"])
+    out = {
+        "floor": floor,
+        "measured": round(val, 3),
+        "ratio": round(val / floor, 3),
+        "recorded": floor_rec.get("recorded"),
+    }
+    out["verdict"] = "fail" if val < 0.9 * floor else "ok"
+    return out
+
+
 PARITY_STOP_WHEN_DONE = {
     "done_at": True,
     "traffic_counters": False,
@@ -591,6 +734,13 @@ def _headline(
         },
         "compile_s": result.get("compile_s"),
         "run_s": result.get("run_s"),
+        # chip-independent per-tick budget (ROADMAP item 1) vs measured
+        "target_tick_us": round(target_tick_us(n_replicas), 1),
+        "measured_tick_us": (
+            round(result["run_s"] / SIM_MS * 1e6, 1)
+            if result.get("run_s")
+            else None
+        ),
         "oracle_sims_per_sec": round(oracle, 4),
         "parity": PARITY_STOP_WHEN_DONE,
         "rungs": rungs,
@@ -806,7 +956,20 @@ def main() -> None:
             rec["phase_profile"] = {
                 "error": f"{type(e).__name__}: {str(e)[:300]}"
             }
+    if platform != "tpu":
+        verdict = check_cpu_floor(results)
+        if verdict is not None:
+            rec["cpu_floor"] = verdict
     _emit(rec)
+    if rec.get("cpu_floor", {}).get("verdict") == "fail":
+        v = rec["cpu_floor"]
+        print(
+            f"BENCH FLOOR VIOLATION: 256x4 measured {v['measured']} "
+            f"sims/sec is >10% below the recorded CPU floor {v['floor']} "
+            f"({_floor_path()}) — engine or supervisor regression",
+            file=sys.stderr,
+        )
+        sys.exit(1)
 
 
 if __name__ == "__main__":
@@ -815,6 +978,18 @@ if __name__ == "__main__":
         # parent already established the platform)
         budget = float(sys.argv[4]) if len(sys.argv) > 4 else 1e9
         print(json.dumps(bench_batched(int(sys.argv[2]), int(sys.argv[3]), budget)))
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--overhead":
+        # supervisor-overhead audit on the CPU 256x4 rung: one JSON
+        # line, rc=1 when the supervised loop costs >2% over raw
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        rec = overhead_check(
+            int(sys.argv[2]) if len(sys.argv) > 2 else 256,
+            int(sys.argv[3]) if len(sys.argv) > 3 else 4,
+        )
+        print(json.dumps(rec))
+        sys.exit(0 if rec["ok"] else 1)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--phase-profile":
         # standalone microbenchmark mode: per-phase wall time + wheel
         # occupancy high-water, one JSON line (CPU by default — pass

@@ -61,6 +61,14 @@ if CHUNK_MS <= 0 or SIM_MS % CHUNK_MS != 0:
         f"WITT_CAMPAIGN_CHUNK_MS={CHUNK_MS} must be a positive divisor of {SIM_MS}"
     )
 RUNG_BUDGET_S = 900  # full-pass cost cap per rung (checked between chunks)
+# rung passes checkpoint through engine.checkpoint every N chunks (at
+# CHUNK_MS=20 that's one state write per 100 simulated ms): an aborted
+# or wedge-killed pass RESUMES at its last checkpoint on the next
+# campaign entry instead of restarting the rung from scratch
+CKPT_ROOT = os.environ.get(
+    "WITT_CAMPAIGN_CKPT", os.path.join(ROOT, ".campaign_ckpt")
+)
+CHECKPOINT_EVERY = int(os.environ.get("WITT_CAMPAIGN_CKPT_EVERY", "5"))
 
 
 def log(rec: dict) -> None:
@@ -169,41 +177,82 @@ def campaign() -> None:
             log({"event": ev, "replicas": r, "chunk": i, "chunk_s": chunk_s})
             _phase_deadline[0] = time.time() + CHUNK_LIMIT_S
 
-        def full_pass(st, budget_s):
-            """The shared never-kill-mid-call loop (bench.chunked_pass);
-            early chunks are cheap — empty-ms jumps — so per-chunk times
-            are logged, not assumed."""
+        from wittgenstein_tpu.engine.checkpoint import (
+            CheckpointManager,
+            read_manifest,
+        )
+        from wittgenstein_tpu.runtime import stable_run_key
+
+        run_key = stable_run_key(net, states, n_chunks, CHUNK_MS)
+        ck_base = os.path.join(CKPT_ROOT, f"{NODES}x{r}")
+
+        def full_pass(st, budget_s, tag, r=r):
+            """The shared never-kill-mid-call loop (bench.chunked_pass,
+            now runtime.Supervisor underneath); early chunks are cheap —
+            empty-ms jumps — so per-chunk times are logged, not assumed.
+            Checkpoints under ck_base/tag: an aborted/killed pass resumes
+            at its last completed chunk on the next campaign entry.
+            Returns (out, this_run_times, ok, total_pass_s, resumed)."""
+            ckdir = os.path.join(ck_base, tag)
+            mgr = CheckpointManager(ckdir)
+            pre_step = mgr.latest_step()
+            if pre_step:
+                log({"event": "rung_resume", "nodes": NODES, "replicas": r,
+                     "pass": tag, "from_chunk": pre_step})
             _phase_deadline[0] = time.time() + CHUNK_LIMIT_S
             try:
-                return benchmod.chunked_pass(
-                    compiled, st, n_chunks, budget_s, heartbeat=heartbeat
+                out, times, ok = benchmod.chunked_pass(
+                    compiled, st, n_chunks, budget_s,
+                    heartbeat=heartbeat,
+                    checkpoint_dir=ckdir, run_key=run_key,
+                    chunk_ms=CHUNK_MS, checkpoint_every=CHECKPOINT_EVERY,
                 )
             finally:
                 _phase_deadline[0] = None
+            # total pass cost across ALL invocations (the checkpoint
+            # meta accumulates chunk_seconds) — a resumed timed pass must
+            # not report sims_per_sec from its remaining chunks only
+            total_s = sum(times)
+            step = mgr.latest_step()
+            if step:
+                man = read_manifest(mgr.path_for(step)) or {}
+                saved = man.get("meta", {}).get("chunk_seconds")
+                if saved:
+                    total_s = sum(saved)
+            return out, times, ok, total_s, bool(pre_step)
 
         def fresh_states():
             return jax.tree_util.tree_map(jnp.copy, states)
 
         t0 = time.perf_counter()
-        out, warm_times, ok = full_pass(fresh_states(), RUNG_BUDGET_S)
+        out, warm_times, ok, _, warm_resumed = full_pass(
+            fresh_states(), RUNG_BUDGET_S, "warm"
+        )
         warm_s = time.perf_counter() - t0
         if not ok:
             log({"event": "rung_aborted", "nodes": NODES, "replicas": r,
-                 "chunk_times": warm_times,
+                 "chunk_times": warm_times, "resumable": True,
                  "reason": f"pass exceeded {RUNG_BUDGET_S}s budget"})
             break
         ok_done = bool(out.done_at.min() > 0)
         t0 = time.perf_counter()
-        out, chunk_times, ok = full_pass(fresh_states(), RUNG_BUDGET_S)
+        out, chunk_times, ok, timed_total_s, timed_resumed = full_pass(
+            fresh_states(), RUNG_BUDGET_S, "timed"
+        )
         run_s = time.perf_counter() - t0
         if not ok:
             # a partial timed pass must NOT be logged as a completed rung:
             # done_rungs() would skip it forever and sims_per_sec would be
-            # inflated by the missing chunks
+            # inflated by the missing chunks — but its checkpoint survives,
+            # so the next campaign entry finishes it instead of restarting
             log({"event": "rung_aborted", "nodes": NODES, "replicas": r,
-                 "chunk_times": chunk_times,
+                 "chunk_times": chunk_times, "resumable": True,
                  "reason": "timed pass exceeded budget (worker degraded?)"})
             break
+        if timed_resumed:
+            # wall time this invocation misses the pre-kill chunks; the
+            # checkpoint-accumulated per-chunk total is the honest cost
+            run_s = timed_total_s
         from wittgenstein_tpu.telemetry import counters
 
         rec = {
@@ -213,6 +262,7 @@ def campaign() -> None:
             "sims_per_sec": round(r / run_s, 4),
             "per_tick_ms": round(run_s / SIM_MS * 1e3, 2),
             "all_done": ok_done,
+            "resumed": bool(warm_resumed or timed_resumed),
             "chunk_times": chunk_times,
             "displaced": int(out.proto["displaced"].sum()),
             # telemetry counter summary of the measured final state (the
@@ -222,6 +272,12 @@ def campaign() -> None:
         }
         log(rec)
         results.append(rec)
+        # the rung is durably logged: drop its checkpoints so a later
+        # campaign with a cleaned jsonl can never resume a finished pass
+        # into an instant (and wrongly cheap) "measurement"
+        import shutil
+
+        shutil.rmtree(ck_base, ignore_errors=True)
         # stop climbing when doubling replicas stopped paying (<1.25x)
         if len(results) >= 2 and results[-1]["sims_per_sec"] < 1.25 * results[-2]["sims_per_sec"]:
             log({"event": "saturated", "at_replicas": r})

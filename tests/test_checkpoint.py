@@ -89,3 +89,199 @@ class TestCheckpoint:
         b = sim.run_ms(back, 100_000)
         assert int(a.n_blocks) == int(b.n_blocks)
         assert (np.asarray(a.td) == np.asarray(b.td)).all()
+
+
+class TestCheckpointV2:
+    """Format v2: embedded manifest, side-car signatures, integrity
+    checksums, layout-stamp compatibility (docs/durability.md)."""
+
+    def _armed(self, n=32, replicas=2):
+        from wittgenstein_tpu.faults import FaultPlan
+        from wittgenstein_tpu.telemetry.state import TelemetryConfig
+
+        net, states = _make(n, replicas)
+        fnet, fstates = net.with_faults(
+            states, plan=FaultPlan("crash5").crash([5], at=50, recover=150)
+        )
+        return fnet.with_telemetry(
+            fstates, TelemetryConfig(snapshots=2, snapshot_every_ms=100)
+        )
+
+    def test_manifest_contents(self, tmp_path):
+        from wittgenstein_tpu.engine.checkpoint import (
+            ENGINE_LAYOUT,
+            MANIFEST_FORMAT,
+            read_manifest,
+        )
+
+        net, states = _make()
+        ckpt = str(tmp_path / "s.npz")
+        manifest = save_state(states, ckpt, meta={"rung": 7})
+        assert read_manifest(ckpt) == manifest
+        assert manifest["format"] == MANIFEST_FORMAT
+        assert manifest["layout"] == ENGINE_LAYOUT
+        assert manifest["meta"] == {"rung": 7}
+        # uninstrumented state: both side-car slots declared empty
+        assert manifest["sidecars"] == {"tele": None, "faults": None}
+        for info in manifest["leaves"].values():
+            assert set(info) == {"crc32", "shape", "dtype"}
+
+    def test_sidecar_roundtrip_and_signature(self, tmp_path):
+        import jax
+
+        tnet, tstates = self._armed()
+        out = tnet.run_ms_batched(tstates, 200)
+        ckpt = str(tmp_path / "armed.npz")
+        manifest = save_state(out, ckpt)
+        assert manifest["sidecars"]["tele"] == "TelemetryState"
+        assert manifest["sidecars"]["faults"] == "FaultState"
+        back = load_state(out, ckpt)
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(out)[0],
+            jax.tree_util.tree_flatten_with_path(back)[0],
+        ):
+            assert (np.asarray(a) == np.asarray(b)).all(), pa
+
+    def test_sidecar_mismatch_rejected_both_ways(self, tmp_path):
+        from wittgenstein_tpu.engine.checkpoint import CheckpointLayoutError
+
+        net, plain = _make()
+        tnet, armed = self._armed()
+        p_ck = str(tmp_path / "plain.npz")
+        a_ck = str(tmp_path / "armed.npz")
+        save_state(plain, p_ck)
+        save_state(armed, a_ck)
+        with pytest.raises(CheckpointLayoutError, match="side-car"):
+            load_state(armed, p_ck)  # saved plain, loaded instrumented
+        with pytest.raises(CheckpointLayoutError, match="side-car"):
+            load_state(plain, a_ck)  # saved instrumented, loaded plain
+
+    def test_truncated_file_is_corrupt_not_shape_trace(self, tmp_path):
+        from wittgenstein_tpu.engine.checkpoint import CheckpointCorruptError
+
+        net, states = _make()
+        ckpt = str(tmp_path / "s.npz")
+        save_state(states, ckpt)
+        import os
+
+        whole = open(ckpt, "rb").read()
+        with open(ckpt, "wb") as f:
+            f.write(whole[: len(whole) // 3])
+        with pytest.raises(CheckpointCorruptError):
+            load_state(states, ckpt)
+        # not-an-npz garbage gets the same structured failure
+        with open(ckpt, "wb") as f:
+            f.write(b"definitely not a zip archive")
+        with pytest.raises(CheckpointCorruptError):
+            load_state(states, ckpt)
+        assert os.path.exists(ckpt)  # load never unlinks
+
+    def test_bitflip_fails_integrity_checksum(self, tmp_path):
+        from wittgenstein_tpu.engine.checkpoint import (
+            CheckpointCorruptError,
+            LAYOUT_KEY,
+            MANIFEST_KEY,
+        )
+
+        net, states = _make()
+        ckpt = str(tmp_path / "s.npz")
+        save_state(states, ckpt)
+        # rewrite the archive with one leaf perturbed but the ORIGINAL
+        # manifest: shapes/dtypes still match, only the crc32 can tell
+        with np.load(ckpt, allow_pickle=False) as data:
+            arrays = {k: data[k] for k in data.files}
+        victim = next(
+            k for k, v in arrays.items()
+            if k not in (LAYOUT_KEY, MANIFEST_KEY) and v.size and v.dtype != bool
+        )
+        arrays[victim] = arrays[victim].copy()
+        arrays[victim].flat[0] += 1
+        np.savez(ckpt, **arrays)
+        with pytest.raises(CheckpointCorruptError, match="integrity"):
+            load_state(states, ckpt)
+        # verify=False skips the crc (the escape hatch is explicit)
+        load_state(states, ckpt, verify=False)
+
+    def test_v1_layout_loads_only_uninstrumented(self, tmp_path):
+        import jax
+        from wittgenstein_tpu.engine.checkpoint import (
+            CheckpointLayoutError,
+            LAYOUT_KEY,
+            _path_str,
+        )
+
+        net, states = _make()
+        # a pre-side-car era checkpoint: leaves + layout stamp, no manifest
+        arrays = {LAYOUT_KEY: np.asarray("timewheel-v1")}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(states)[0]:
+            arrays[_path_str(path)] = np.asarray(leaf)
+        ckpt = str(tmp_path / "v1.npz")
+        np.savez(ckpt, **arrays)
+
+        back = load_state(states, ckpt)  # plain template: allowed
+        assert (np.asarray(back.time) == np.asarray(states.time)).all()
+
+        tnet, armed = self._armed()
+        with pytest.raises(CheckpointLayoutError, match="pre-side-car"):
+            load_state(armed, ckpt)
+
+    def test_unknown_layout_rejected(self, tmp_path):
+        import jax
+        from wittgenstein_tpu.engine.checkpoint import (
+            CheckpointLayoutError,
+            LAYOUT_KEY,
+            _path_str,
+        )
+
+        net, states = _make()
+        arrays = {LAYOUT_KEY: np.asarray("flatring-v0")}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(states)[0]:
+            arrays[_path_str(path)] = np.asarray(leaf)
+        ckpt = str(tmp_path / "old.npz")
+        np.savez(ckpt, **arrays)
+        with pytest.raises(CheckpointLayoutError, match="flatring-v0"):
+            load_state(states, ckpt)
+
+    def test_atomic_write_leaves_no_temp(self, tmp_path):
+        import os
+
+        net, states = _make()
+        dest = str(tmp_path / "s.npz")
+        save_state(states, dest)
+        assert sorted(os.listdir(tmp_path)) == ["s.npz"]
+
+
+class TestCheckpointManager:
+    def _toy(self, step):
+        return {"x": np.arange(4, dtype=np.int32) + step}
+
+    def test_retention_and_latest_pointer(self, tmp_path):
+        from wittgenstein_tpu.engine.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        for step in (1, 2, 3, 4):
+            mgr.save(self._toy(step), step)
+        assert mgr.steps() == [3, 4]  # pruned to keep=2
+        assert mgr.latest_step() == 4
+        state, step, manifest = mgr.restore_latest(self._toy(0))
+        assert step == 4
+        assert (np.asarray(state["x"]) == np.arange(4, dtype=np.int32) + 4).all()
+
+    def test_restore_walks_past_corrupt_newest(self, tmp_path):
+        from wittgenstein_tpu.engine.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        mgr.save(self._toy(1), 1)
+        mgr.save(self._toy(2), 2)
+        with open(mgr.path_for(2), "wb") as f:
+            f.write(b"torn by a crash")
+        state, step, _ = mgr.restore_latest(self._toy(0))
+        assert step == 1  # newest LOADABLE, not newest file
+        assert (np.asarray(state["x"]) == np.arange(4, dtype=np.int32) + 1).all()
+
+    def test_restore_none_when_empty(self, tmp_path):
+        from wittgenstein_tpu.engine.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path))
+        assert mgr.restore_latest(self._toy(0)) is None
+        assert mgr.latest_step() is None

@@ -288,3 +288,97 @@ class TestStaticUI:
             assert "protocolParameters" in page  # editable params pane
             assert "/network/init/" in page  # init wiring
             assert "runMs" in page and "nodeStatus" in page
+
+
+class TestDurableRunEndpoints:
+    """ISSUE 6 durability surfaces: busy/degraded 503 + Retry-After,
+    the interrupt endpoint, and interrupted-runMs resume."""
+
+    def _fresh(self, node_ct=30):
+        ws = WServer()
+        params = json.loads(
+            ws.server.get_protocol_parameters("PingPong").to_json()
+        )
+        params["node_ct"] = node_ct
+        ws.dispatch("POST", "/w/network/init/PingPong", json.dumps(params))
+        return ws
+
+    def test_interrupt_endpoint_idle(self, base_url):
+        status, out = post(base_url, "/w/network/interrupt")
+        assert status == 200
+        assert out == {"ok": True, "running": False}
+
+    def test_busy_503_with_retry_after(self):
+        ws = self._fresh()
+        assert ws.run_lock.acquire(blocking=False)  # a run "in flight"
+        try:
+            status, resp = ws.dispatch("POST", "/w/network/runMs/100", "")
+            assert status == 503
+            assert resp.payload["busy"] is True
+            assert int(resp.headers["Retry-After"]) >= 1
+        finally:
+            ws.run_lock.release()
+        # lock released: the same request now runs
+        status, out = ws.dispatch("POST", "/w/network/runMs/100", "")
+        assert status == 200 and out["ok"] is True
+
+    def test_degraded_503_until_reinit(self):
+        ws = self._fresh()
+        ws.degraded = True
+        ws.degraded_reason = "RuntimeError: slice blew up"
+        status, resp = ws.dispatch("POST", "/w/network/runMs/50", "")
+        assert status == 503
+        assert resp.payload["degraded"] is True
+        assert "slice blew up" in resp.payload["error"]
+        assert resp.headers["Retry-After"] == "30"
+        status, st = ws.dispatch("GET", "/w/network/status", "")
+        assert st["degraded"] is True and "slice blew up" in st["degradedReason"]
+        # re-init clears the latch (a fresh sim is a fresh backend)
+        params = json.loads(
+            ws.server.get_protocol_parameters("PingPong").to_json()
+        )
+        ws.dispatch("POST", "/w/network/init/PingPong", json.dumps(params))
+        status, out = ws.dispatch("POST", "/w/network/runMs/50", "")
+        assert status == 200 and out["ok"] is True
+
+    def test_slice_failure_latches_degraded(self):
+        ws = self._fresh()
+        def boom(ms):
+            raise OSError("backend fell over")
+        ws.server.run_ms = boom
+        status, resp = ws.dispatch("POST", "/w/network/runMs/100", "")
+        assert status == 500
+        assert ws.degraded is True and "backend fell over" in ws.degraded_reason
+        status, _ = ws.dispatch("POST", "/w/network/runMs/100", "")
+        assert status == 503  # honest 503 from now on, not a race
+
+    def test_uninitialized_runms_is_409_not_degraded(self):
+        ws = WServer()  # no init
+        status, _ = ws.dispatch("POST", "/w/network/runMs/10", "")
+        assert status == 409
+        assert ws.degraded is False  # operator error, not a backend fault
+
+    def test_interrupted_runms_resumes(self):
+        """Interrupt lands on a slice boundary; a repeat runMs with the
+        remaining ms resumes to the exact total sim time."""
+        ws = self._fresh()
+        orig = ws.server.run_ms
+
+        def run_then_interrupt(ms):
+            orig(ms)
+            ws._interrupt.set()  # as if POST /w/network/interrupt raced in
+
+        ws.server.run_ms = run_then_interrupt
+        status, out = ws.dispatch("POST", "/w/network/runMs/200", "")
+        assert status == 200
+        assert out["interrupted"] is True and out["ok"] is False
+        assert out["ranMs"] == ws.RUN_SLICE_MS  # stopped after one slice
+        assert out["requestedMs"] == 200
+        assert out["time"] == ws.RUN_SLICE_MS
+
+        ws.server.run_ms = orig
+        remaining = 200 - out["ranMs"]
+        status, out2 = ws.dispatch("POST", f"/w/network/runMs/{remaining}", "")
+        assert status == 200 and out2["ok"] is True
+        assert out2["interrupted"] is False
+        assert out2["time"] == 200  # state was consistent at the boundary

@@ -1,0 +1,179 @@
+"""Checkpoint completeness check (SL501).
+
+Durable runs (wittgenstein_tpu.runtime) rest on one claim: a checkpoint
+holds EVERYTHING the engine needs to resume bit-identically.  That claim
+silently breaks the day someone adds a SimState field and forgets that
+`engine.checkpoint.save_state` flattens whatever the pytree exposes — a
+leaf hidden behind a custom flatten, or one declared ephemeral years ago
+for a reason that no longer holds, resumes as its template value and the
+divergence surfaces three experiments later as "the resumed sweep
+doesn't match".
+
+SL501 closes the loop per registered protocol, at the same small
+analysis scale the other dynamic passes use:
+
+- **save coverage** — every leaf of the entry's state tree must land in
+  the saved archive under its tree path, or be declared in
+  `engine.checkpoint.EPHEMERAL_LEAVES`;
+- **stale declarations** — every EPHEMERAL_LEAVES entry must still name
+  a real leaf (a stale declaration would silently exempt a future field
+  that reuses the name);
+- **bitwise roundtrip** — save -> load must reproduce every persisted
+  leaf bit-for-bit (shape, dtype, and payload bytes).
+
+Fault-enabled registry entries exercise the fault side-car lane; for
+plain entries the check additionally arms telemetry
+(`with_telemetry`, snapshots=0) so the tele side-car's persistence is
+covered even though no registry entry ships instrumented by default.
+
+Protocol-level suppression: list "SL501" in the class's
+SIMLINT_SUPPRESS tuple (same mechanism as the other dynamic rules).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import List
+
+from .contracts import _cpu_jax, _leaf_paths, _mk, _proto_location
+from .findings import Finding
+
+_MAX_LEAF_REPORTS = 4
+
+
+def _check_state_checkpoints(
+    jax, name, state, tag, path, line, suppress
+) -> List[Finding]:
+    """Save `state`, assert key coverage and a bitwise roundtrip."""
+    import numpy as np
+
+    from ..engine import checkpoint as ck
+
+    findings: List[Finding] = []
+    # _leaf_paths uses keystr ('.a.b' / '[0]'); save_state keys by its own
+    # _path_str — compare with the real keying so the check is against
+    # what actually lands in the archive
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    keys = [ck._path_str(p) for p, _ in flat]
+
+    with tempfile.TemporaryDirectory(prefix="simlint_sl501_") as td:
+        dest = os.path.join(td, "state.npz")
+        try:
+            ck.save_state(state, dest)
+        except Exception as e:
+            f = _mk("SL501", path, line,
+                    f"[{name}] save_state failed on the {tag} state: "
+                    f"{type(e).__name__}: {e}", suppress)
+            return [f] if f else []
+
+        with np.load(dest, allow_pickle=False) as data:
+            stored = set(data.files)
+        missing = [k for k in keys
+                   if k not in stored and k not in ck.EPHEMERAL_LEAVES]
+        for k in missing[:_MAX_LEAF_REPORTS]:
+            f = _mk("SL501", path, line,
+                    f"[{name}] {tag} state leaf {k!r} is not persisted by "
+                    "save_state and not declared in "
+                    "checkpoint.EPHEMERAL_LEAVES — a resumed run would "
+                    "silently reset it to the template value", suppress)
+            if f:
+                findings.append(f)
+        if len(missing) > _MAX_LEAF_REPORTS:
+            f = _mk("SL501", path, line,
+                    f"[{name}] ... and {len(missing) - _MAX_LEAF_REPORTS} "
+                    "more unpersisted leaves", suppress)
+            if f:
+                findings.append(f)
+
+        stale = [e for e in sorted(ck.EPHEMERAL_LEAVES) if e not in keys]
+        for e in stale[:_MAX_LEAF_REPORTS]:
+            f = _mk("SL501", path, line,
+                    f"[{name}] EPHEMERAL_LEAVES declares {e!r} but the "
+                    f"{tag} state has no such leaf — remove the stale "
+                    "declaration before it exempts a future field",
+                    suppress)
+            if f:
+                findings.append(f)
+        if missing:
+            return findings  # roundtrip would only re-report the gap
+
+        try:
+            restored = ck.load_state(state, dest)
+        except Exception as e:
+            f = _mk("SL501", path, line,
+                    f"[{name}] load_state failed roundtripping the {tag} "
+                    f"state: {type(e).__name__}: {e}", suppress)
+            if f:
+                findings.append(f)
+            return findings
+
+    for (p, a), (_, b) in zip(
+        _leaf_paths(jax, state), _leaf_paths(jax, restored)
+    ):
+        na, nb = np.asarray(a), np.asarray(b)
+        if (na.shape != nb.shape or na.dtype != nb.dtype
+                or na.tobytes() != nb.tobytes()):
+            f = _mk("SL501", path, line,
+                    f"[{name}] {tag} state leaf {p} does not roundtrip "
+                    "bitwise through save_state/load_state", suppress)
+            if f:
+                findings.append(f)
+            break
+    return findings
+
+
+def check_entry_checkpoint(entry, root: str = ".") -> List[Finding]:
+    """SL501 for one registry entry; [] when clean or when the entry
+    opts out of contract checks (standalone engines checkpoint through
+    the same save_state path but have no generic SimState contract)."""
+    jax = _cpu_jax()
+    if not entry.contract_checks:
+        return []
+    net, state = entry.factory()
+    path, line = _proto_location(net.protocol)
+    try:
+        path = os.path.relpath(path, root)
+    except ValueError:
+        pass
+    suppress = set(getattr(net.protocol, "SIMLINT_SUPPRESS", ()) or ())
+    if "SL501" in suppress:
+        return []
+
+    findings = _check_state_checkpoints(
+        jax, entry.name, state, "plain", path, line, suppress
+    )
+
+    # plain entries also get the telemetry side-car armed, so the tele
+    # lane's persistence is checked; fault-lane entries already carry
+    # their side-car from the factory
+    if getattr(net, "tele", None) is None and hasattr(net, "with_telemetry"):
+        from ..telemetry.state import TelemetryConfig
+
+        try:
+            _tnet, tstate = net.with_telemetry(
+                state, TelemetryConfig(snapshots=0)
+            )
+        except Exception as e:
+            f = _mk("SL501", path, line,
+                    f"[{entry.name}] telemetry instrumentation failed "
+                    f"while arming the side-car checkpoint check: "
+                    f"{type(e).__name__}: {e}", suppress)
+            return findings + ([f] if f else [])
+        findings += _check_state_checkpoints(
+            jax, entry.name, tstate, "telemetry-armed", path, line, suppress
+        )
+    return findings
+
+
+def check_checkpoints(root: str = ".", names=None) -> List[Finding]:
+    """SL501 over every registered batched protocol (or the named
+    subset)."""
+    from ..core.registries import registry_batched_protocols
+
+    findings: List[Finding] = []
+    for entry in registry_batched_protocols.entries():
+        if names and entry.name not in names:
+            continue
+        findings.extend(check_entry_checkpoint(entry, root=root))
+    return findings

@@ -65,6 +65,11 @@ RULES: Dict[str, str] = {
     "engine on the neutral schedule perturbs non-fault state",
     "SL407": "deliver() writes the fault lane: state.faults leaves must "
     "be pure passthroughs on a fault-enabled delivery view",
+    # -- checkpoint durability -----------------------------------------------
+    "SL501": "checkpoint completeness: a state leaf is not persisted by "
+    "save_state (and not declared in EPHEMERAL_LEAVES), an "
+    "EPHEMERAL_LEAVES declaration is stale, or save/load does not "
+    "roundtrip bitwise",
 }
 
 

@@ -7,16 +7,48 @@ a pytree of arrays, so checkpointing is a flatten + np.savez: save at
 any tick, load, continue — bit-identical to an uninterrupted run (the
 engine is deterministic in (state, tick count)).
 
+Format v2 adds durability on top of the bare flatten:
+
+- an embedded JSON **manifest** (``__manifest__``) recording the engine
+  layout generation, the side-car signature (telemetry / fault state
+  attached or not), per-leaf crc32/shape/dtype, and caller metadata;
+- **integrity checksums** — a flipped bit surfaces as
+  ``CheckpointCorruptError`` with the offending leaf named, never as a
+  numpy shape trace three frames deep;
+- **atomic writes** (pid-suffixed temp + ``os.replace``) so a killed
+  writer can never leave a torn checkpoint under the final name;
+- ``CheckpointManager``: numbered checkpoints, an atomic LATEST
+  pointer, bounded retention, and a restore that walks back past
+  corrupt files to the newest loadable state.
+
 Works for any pytree whose leaves are arrays/scalars and whose structure
 is reproducible from a template state (SimState with nested proto dicts,
 EthPowState, stacked/replicated variants).
+
+Layout-stamp compatibility rules (also in docs/durability.md):
+
+- ``ENGINE_LAYOUT`` names the current message-store generation and is
+  stamped into every checkpoint.
+- A checkpoint stamped with an unknown layout never loads.
+- ``timewheel-v1`` (pre-side-car) checkpoints load **only** into a
+  template with no telemetry/fault side-cars attached; against an
+  instrumented template they fail with ``CheckpointLayoutError`` naming
+  the reason, because the side-car counters they lack are part of the
+  bit-identity contract.
+- A v2 checkpoint whose side-car signature differs from the template's
+  (e.g. saved with telemetry ON, loaded with telemetry OFF) fails the
+  same way before any leaf is touched.
 """
 
 from __future__ import annotations
 
 
+import json
 import os
-from typing import Any
+import time
+import zipfile
+import zlib
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -40,50 +72,309 @@ def _path_str(path) -> str:
 # time-wheel rewrite changed SimState's ring fields ([C] flat ring ->
 # [W, B] wheel + [V] overflow lane), so a checkpoint from the flat-ring
 # era can never resume on this engine — fail with the reason, not with a
-# leaf-by-leaf shape mismatch
+# leaf-by-leaf shape mismatch.  v2 = v1 wheel layout + side-car aware
+# manifest (telemetry/fault state signatures + per-leaf checksums).
 LAYOUT_KEY = "__engine_layout__"
-ENGINE_LAYOUT = "timewheel-v1"
+MANIFEST_KEY = "__manifest__"
+ENGINE_LAYOUT = "timewheel-v2"
+# older stamps this engine can still load, with restrictions enforced in
+# load_state (v1 predates the side-car signature, so it only loads into
+# an uninstrumented template)
+COMPAT_LAYOUTS = ("timewheel-v1",)
+MANIFEST_FORMAT = 2
+
+# SimState leaves that a checkpoint may legitimately omit (none today:
+# every leaf participates in the bit-identity contract).  simlint SL501
+# asserts save/restore completeness against this set — a new SimState
+# field must either checkpoint bitwise or be declared here with a reason.
+EPHEMERAL_LEAVES: frozenset = frozenset()
 
 
-def save_state(state: Any, dest: str) -> None:
-    """Write a state pytree to `dest` (.npz), keyed by tree path."""
+class CheckpointError(Exception):
+    """Base for every structured checkpoint failure."""
+
+
+class CheckpointLayoutError(CheckpointError, ValueError):
+    """Engine-layout or side-car signature mismatch: the checkpoint was
+    written by an incompatible engine generation/configuration."""
+
+
+class CheckpointCorruptError(CheckpointError, ValueError):
+    """The checkpoint file is truncated, unreadable, or fails its
+    integrity checksum."""
+
+
+class CheckpointMissingLeafError(CheckpointError, KeyError):
+    """The checkpoint lacks a leaf the template requires."""
+
+
+class CheckpointShapeError(CheckpointError, ValueError):
+    """A stored leaf's shape/dtype disagrees with the template."""
+
+
+def _sidecar_name(leaf: Any) -> Optional[str]:
+    """Side-car signature entry: the attached state's type name, or None
+    when the side-car is disabled (an empty-tuple leaf)."""
+    if isinstance(leaf, tuple) and len(leaf) == 0:
+        return None
+    return type(leaf).__name__
+
+
+def _sidecar_signature(state: Any) -> Dict[str, Optional[str]]:
+    sig: Dict[str, Optional[str]] = {}
+    for name in ("tele", "faults"):
+        if hasattr(state, name):
+            sig[name] = _sidecar_name(getattr(state, name))
+    return sig
+
+
+def save_state(state: Any, dest: str, meta: Optional[dict] = None) -> dict:
+    """Write a state pytree to `dest` (.npz), keyed by tree path.
+
+    Embeds a manifest (layout stamp, side-car signature, per-leaf
+    crc32/shape/dtype, caller `meta`) and writes atomically: a crashed
+    writer leaves at most a stray temp file, never a torn `dest`.
+    Returns the manifest dict.
+    """
     leaves = jax.tree_util.tree_flatten_with_path(state)[0]
     arrays = {LAYOUT_KEY: np.asarray(ENGINE_LAYOUT)}
+    leaf_info: Dict[str, dict] = {}
     for path, leaf in leaves:
-        arrays[_path_str(path)] = np.asarray(leaf)
+        key = _path_str(path)
+        arr = np.asarray(leaf)
+        arrays[key] = arr
+        leaf_info[key] = {
+            "crc32": zlib.crc32(arr.tobytes()) & 0xFFFFFFFF,
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        }
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "layout": ENGINE_LAYOUT,
+        "sidecars": _sidecar_signature(state),
+        "leaves": leaf_info,
+        "meta": dict(meta or {}),
+        "created_unix": time.time(),
+    }
+    arrays[MANIFEST_KEY] = np.asarray(json.dumps(manifest))
     # stream straight to a temp file (savez appends .npz when missing),
-    # then atomically replace — never a torn checkpoint, no in-RAM copy
-    tmp = dest + ".tmp.npz"
-    np.savez_compressed(tmp, **arrays)
-    os.replace(tmp, dest)
+    # then atomically replace — never a torn checkpoint, no in-RAM copy;
+    # pid suffix keeps concurrent writers off each other's temp file
+    tmp = f"{dest}.tmp.{os.getpid()}.npz"
+    try:
+        np.savez_compressed(tmp, **arrays)
+        os.replace(tmp, dest)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return manifest
 
 
-def load_state(template: Any, src: str) -> Any:
+def _open_npz(src: str):
+    try:
+        return np.load(src, allow_pickle=False)
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, ValueError, OSError, EOFError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {src} is unreadable (truncated or not an npz): {e}"
+        ) from e
+
+
+def read_manifest(src: str) -> Optional[dict]:
+    """Return the embedded manifest dict, or None for a pre-manifest
+    (v1) checkpoint.  Raises CheckpointCorruptError on unreadable files."""
+    with _open_npz(src) as data:
+        if MANIFEST_KEY not in data:
+            return None
+        try:
+            return json.loads(str(data[MANIFEST_KEY]))
+        except (json.JSONDecodeError, zlib.error, zipfile.BadZipFile) as e:
+            raise CheckpointCorruptError(
+                f"checkpoint {src} has a corrupt manifest: {e}"
+            ) from e
+
+
+def _check_layout(src: str, found: str, template: Any) -> None:
+    if found == ENGINE_LAYOUT:
+        return
+    if found in COMPAT_LAYOUTS:
+        # v1 predates the side-car signature: it can only resume an
+        # uninstrumented run — telemetry/fault counters it never stored
+        # are part of the bit-identity contract when armed
+        sig = _sidecar_signature(template)
+        armed = [k for k, v in sig.items() if v is not None]
+        if armed:
+            raise CheckpointLayoutError(
+                f"checkpoint {src} was written by pre-side-car engine "
+                f"layout {found!r}, but the template has "
+                f"{'/'.join(armed)} side-car state attached; it cannot "
+                "resume an instrumented run — re-run instead of resuming"
+            )
+        return
+    raise CheckpointLayoutError(
+        f"checkpoint {src} was written by engine layout {found!r}; this "
+        f"engine is {ENGINE_LAYOUT!r} (compat: {COMPAT_LAYOUTS}) — "
+        "re-run the simulation instead of resuming"
+    )
+
+
+def load_state(template: Any, src: str, verify: bool = True) -> Any:
     """Rebuild a state pytree with `template`'s structure from `src`.
-    Shapes and dtypes must match the template's leaves."""
-    with np.load(src) as data:
+
+    Shapes and dtypes must match the template's leaves; with `verify`
+    (default) every leaf is also checked against its manifest crc32, so
+    silent bit-rot surfaces as CheckpointCorruptError naming the leaf.
+    """
+    with _open_npz(src) as data:
         if LAYOUT_KEY in data:
-            found = str(data[LAYOUT_KEY])
-            if found != ENGINE_LAYOUT:
-                raise ValueError(
-                    f"checkpoint {src} was written by engine layout "
-                    f"{found!r}; this engine is {ENGINE_LAYOUT!r} — re-run "
-                    "the simulation instead of resuming"
-                )
-        leaves_t, treedef = jax.tree_util.tree_flatten_with_path(template)
+            _check_layout(src, str(data[LAYOUT_KEY]), template)
+        manifest = None
+        if MANIFEST_KEY in data:
+            try:
+                manifest = json.loads(str(data[MANIFEST_KEY]))
+            except (json.JSONDecodeError, zlib.error, zipfile.BadZipFile) as e:
+                raise CheckpointCorruptError(
+                    f"checkpoint {src} has a corrupt manifest: {e}"
+                ) from e
+            want_sig = _sidecar_signature(template)
+            have_sig = manifest.get("sidecars", {})
+            for name, want in want_sig.items():
+                have = have_sig.get(name)
+                if have != want:
+                    raise CheckpointLayoutError(
+                        f"checkpoint {src} side-car mismatch on {name!r}: "
+                        f"saved with {have!r}, template expects {want!r} — "
+                        "arm the run the same way it was saved"
+                    )
+        leaves_t, _ = jax.tree_util.tree_flatten_with_path(template)
         leaves = []
         for path, leaf in leaves_t:
             key = _path_str(path)
             if key not in data:
-                raise KeyError(f"checkpoint {src} is missing leaf {key!r}")
-            arr = data[key]
+                if key in EPHEMERAL_LEAVES:
+                    leaves.append(jax.numpy.asarray(np.asarray(leaf)))
+                    continue
+                raise CheckpointMissingLeafError(
+                    f"checkpoint {src} is missing leaf {key!r}"
+                )
+            try:
+                arr = data[key]
+            except (zipfile.BadZipFile, zlib.error, ValueError, EOFError) as e:
+                raise CheckpointCorruptError(
+                    f"checkpoint {src} leaf {key!r} is unreadable "
+                    f"(truncated archive?): {e}"
+                ) from e
             want = np.asarray(leaf)
             if arr.shape != want.shape or arr.dtype != want.dtype:
-                raise ValueError(
+                raise CheckpointShapeError(
                     f"leaf {key!r}: checkpoint has {arr.shape}/{arr.dtype}, "
                     f"template wants {want.shape}/{want.dtype}"
                 )
+            if verify and manifest is not None:
+                info = manifest.get("leaves", {}).get(key)
+                if info is not None:
+                    crc = zlib.crc32(arr.tobytes())
+                    if (crc & 0xFFFFFFFF) != info.get("crc32"):
+                        raise CheckpointCorruptError(
+                            f"checkpoint {src} leaf {key!r} failed its "
+                            f"integrity checksum (stored crc32 "
+                            f"{info.get('crc32')}, recomputed {crc}) — "
+                            "the file is corrupt; falling back to an "
+                            "older checkpoint is safe, this one is not"
+                        )
             leaves.append(jax.numpy.asarray(arr))
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(template), leaves
         )
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: numbered checkpoints + LATEST pointer + retention
+
+
+LATEST_NAME = "LATEST"
+_CKPT_FMT = "ckpt_{step:08d}.npz"
+
+
+class CheckpointManager:
+    """Numbered checkpoints in one directory with bounded retention.
+
+    - ``save(state, step, meta)`` writes ``ckpt_{step:08d}.npz``
+      atomically, then atomically updates the ``LATEST`` pointer file,
+      then prunes to the ``keep`` newest files.  A crash between any two
+      of those steps leaves a fully consistent directory.
+    - ``restore_latest(template)`` walks newest -> oldest, skipping
+      checkpoints that fail to load (corrupt / truncated / wrong
+      side-car signature), and returns ``(state, step, manifest)`` for
+      the newest loadable one, or ``None`` when nothing usable exists.
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def path_for(self, step: int) -> str:
+        return os.path.join(self.directory, _CKPT_FMT.format(step=step))
+
+    def steps(self) -> list:
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("ckpt_") and name.endswith(".npz"):
+                try:
+                    out.append(int(name[len("ckpt_"):-len(".npz")]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        """Step named by the LATEST pointer, falling back to the newest
+        file on disk when the pointer is missing/stale."""
+        ptr = os.path.join(self.directory, LATEST_NAME)
+        try:
+            with open(ptr) as f:
+                name = f.read().strip()
+            step = int(name[len("ckpt_"):-len(".npz")])
+            if os.path.exists(self.path_for(step)):
+                return step
+        except (OSError, ValueError):
+            pass
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def save(self, state: Any, step: int, meta: Optional[dict] = None) -> dict:
+        manifest = save_state(state, self.path_for(step), meta=meta)
+        ptr = os.path.join(self.directory, LATEST_NAME)
+        tmp = f"{ptr}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(_CKPT_FMT.format(step=step))
+        os.replace(tmp, ptr)
+        self._prune()
+        return manifest
+
+    def _prune(self) -> None:
+        steps = self.steps()
+        for step in steps[: max(0, len(steps) - self.keep)]:
+            try:
+                os.remove(self.path_for(step))
+            except OSError:
+                pass
+
+    def restore_latest(
+        self, template: Any
+    ) -> Optional[Tuple[Any, int, Optional[dict]]]:
+        errors = []
+        for step in reversed(self.steps()):
+            path = self.path_for(step)
+            try:
+                state = load_state(template, path)
+                return state, step, read_manifest(path)
+            except FileNotFoundError:
+                continue
+            except CheckpointError as e:
+                errors.append((path, e))
+                continue
+        return None

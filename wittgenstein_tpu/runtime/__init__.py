@@ -1,0 +1,45 @@
+"""Durable-run runtime: supervised chunked execution with
+checkpoint/resume, watchdogs, bounded retry, and graceful degradation.
+
+See docs/durability.md for the operational model.
+"""
+
+from .errors import (
+    DeviceLostError,
+    DurableRunError,
+    FatalRunError,
+    PreemptedError,
+    ResumeMismatchError,
+    RetriesExhaustedError,
+    RunIncompleteError,
+    TransientRunError,
+    WatchdogTimeoutError,
+    classify,
+)
+from .policy import DegradePolicy, RetryPolicy, WatchdogPolicy
+from .supervisor import (
+    RunReport,
+    Supervisor,
+    run_with_deadline,
+    stable_run_key,
+)
+
+__all__ = [
+    "DegradePolicy",
+    "DeviceLostError",
+    "DurableRunError",
+    "FatalRunError",
+    "PreemptedError",
+    "ResumeMismatchError",
+    "RetriesExhaustedError",
+    "RunIncompleteError",
+    "RunReport",
+    "RetryPolicy",
+    "Supervisor",
+    "TransientRunError",
+    "WatchdogPolicy",
+    "WatchdogTimeoutError",
+    "classify",
+    "run_with_deadline",
+    "stable_run_key",
+]

@@ -1,0 +1,134 @@
+"""Structured error taxonomy for the durable-run supervisor.
+
+The split that matters operationally is TRANSIENT vs FATAL:
+
+- **Transient** failures (device lost, preemption, tunnel resets) are
+  the supervisor's to handle — bounded retry with exponential backoff,
+  replaying deterministically from the last host anchor so the retried
+  run is bit-identical to one that never failed.
+- **Fatal** failures (watchdog deadline, shape/layout mismatch on
+  resume, retries exhausted) stop the run with a typed exception the
+  caller can route — never a bare RuntimeError three frames into jax.
+
+`classify` maps arbitrary exceptions (including jax/XLA runtime errors,
+which arrive as generic Exception subclasses with backend-specific
+messages) onto the taxonomy using message markers collected from the
+r3-r5 TPU-tunnel postmortems.
+"""
+
+from __future__ import annotations
+
+
+class DurableRunError(Exception):
+    """Base for every structured supervisor failure."""
+
+
+class TransientRunError(DurableRunError):
+    """Worth retrying: the failure is environmental, not semantic."""
+
+
+class FatalRunError(DurableRunError):
+    """Retrying cannot help; the run stops with this as the reason."""
+
+
+class DeviceLostError(TransientRunError):
+    """The accelerator went away mid-run (tunnel reset, worker crash,
+    preemption of the device)."""
+
+
+class PreemptedError(TransientRunError):
+    """The host/process was asked to stop (scheduler preemption); state
+    up to the last checkpoint survives."""
+
+
+class WatchdogTimeoutError(FatalRunError):
+    """A compile or chunk exceeded its deadline.  Fatal IN-PROCESS: a
+    hung device call cannot be cancelled from Python (killing mid-call
+    wedges the tunneled worker — r3/r4 lesson), so the in-process
+    supervisor stops issuing work and reports; process-level supervisors
+    (tpu_campaign) own the actual kill."""
+
+    def __init__(self, phase: str, deadline_s: float):
+        self.phase = phase
+        self.deadline_s = deadline_s
+        super().__init__(
+            f"{phase} exceeded its {deadline_s:.0f}s watchdog deadline"
+        )
+
+
+class RetriesExhaustedError(FatalRunError):
+    """The retry policy's attempt budget ran out on transient failures."""
+
+    def __init__(self, attempts: int, last: BaseException):
+        self.attempts = attempts
+        self.last = last
+        super().__init__(
+            f"gave up after {attempts} attempts; last failure: "
+            f"{type(last).__name__}: {last}"
+        )
+
+
+class ResumeMismatchError(FatalRunError):
+    """A checkpoint exists but belongs to a different run (run_key or
+    chunk geometry mismatch) — resuming would silently mix runs."""
+
+
+class RunIncompleteError(DurableRunError):
+    """A controlled partial stop (budget exhausted / chunk cap reached).
+    Carries the partial RunReport so callers can checkpoint-and-requeue."""
+
+    def __init__(self, message: str, report=None):
+        self.report = report
+        super().__init__(message)
+
+
+# lowercase substrings that mark an environmental (retryable) failure in
+# backend exception text; collected from real tunnel failures (r3-r5)
+# and the jax/XLA status-code vocabulary
+_TRANSIENT_MARKERS = (
+    "deadline_exceeded",
+    "deadline exceeded",
+    "unavailable",
+    "resource_exhausted",
+    "resource exhausted",
+    "preempt",
+    "worker crashed",
+    "worker process crashed",
+    "connection reset",
+    "connection refused",
+    "broken pipe",
+    "socket closed",
+    "transport closed",
+    "heartbeat",
+)
+
+_DEVICE_LOST_MARKERS = (
+    "device lost",
+    "worker crashed",
+    "worker process crashed",
+    "tpu is dead",
+    "failed to connect",
+    "transport closed",
+)
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception to 'transient' | 'device_lost' | 'fatal'.
+
+    device_lost is a sub-case of transient that additionally makes the
+    current backend suspect — the degradation policy keys off it.
+    """
+    if isinstance(exc, DeviceLostError):
+        return "device_lost"
+    if isinstance(exc, TransientRunError):
+        return "transient"
+    if isinstance(exc, FatalRunError):
+        return "fatal"
+    if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+        return "fatal"
+    text = str(exc).lower()
+    if any(m in text for m in _DEVICE_LOST_MARKERS):
+        return "device_lost"
+    if any(m in text for m in _TRANSIENT_MARKERS):
+        return "transient"
+    return "fatal"

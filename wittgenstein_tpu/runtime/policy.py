@@ -1,0 +1,68 @@
+"""Supervisor policies: retry/backoff, watchdog deadlines, degradation.
+
+All three are frozen dataclasses so they hash/compare cleanly and can be
+stamped into run provenance.  Backoff jitter is DETERMINISTIC (hashed
+from seed + attempt) — a resumed supervisor replays the same delays,
+keeping kill-and-resume runs reproducible end to end, and tests can pin
+exact delay sequences without mocking random.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry with exponential backoff + deterministic jitter.
+
+    attempt n (0-based retry count) sleeps
+      min(backoff_max_s, backoff_base_s * backoff_factor**n) * (1 ± jitter)
+    where jitter is a hash of (seed, n) in [-jitter_frac, +jitter_frac].
+    max_attempts counts EXECUTIONS, not retries: 3 means one initial try
+    plus two retries.
+    """
+
+    max_attempts: int = 3
+    backoff_base_s: float = 0.5
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 30.0
+    jitter_frac: float = 0.25
+    seed: int = 0
+
+    def delay_s(self, attempt: int) -> float:
+        """Backoff delay before retry number `attempt` (0-based)."""
+        base = min(
+            self.backoff_max_s,
+            self.backoff_base_s * (self.backoff_factor ** attempt),
+        )
+        if self.jitter_frac <= 0:
+            return base
+        h = hashlib.blake2b(
+            f"{self.seed}:{attempt}".encode(), digest_size=8
+        ).digest()
+        unit = int.from_bytes(h, "big") / float(1 << 64)  # [0, 1)
+        return base * (1.0 + self.jitter_frac * (2.0 * unit - 1.0))
+
+
+@dataclass(frozen=True)
+class WatchdogPolicy:
+    """Per-phase deadlines.  A chunk that misses its deadline is treated
+    as a hung device and raises WatchdogTimeoutError; the first chunk of
+    a cold process gets compile_deadline_s ON TOP of chunk_deadline_s
+    (jit compiles lazily inside the first call).  Defaults mirror
+    scripts/tpu_campaign.py's process-level limits."""
+
+    chunk_deadline_s: float = 180.0
+    compile_deadline_s: float = 780.0
+
+
+@dataclass(frozen=True)
+class DegradePolicy:
+    """What to do when the device is lost: with cpu_fallback, the
+    supervisor re-places the last anchor on CPU and continues there,
+    stamping {degraded, degraded_at_chunk} into provenance so a CPU
+    number can never masquerade as a TPU number."""
+
+    cpu_fallback: bool = False

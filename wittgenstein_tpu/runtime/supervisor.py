@@ -1,0 +1,454 @@
+"""Supervised chunked-run executor: the durable loop around run_ms.
+
+The engine is deterministic in (state, tick count), so a chunked run is
+bit-identical to a straight one — which makes durability a pure
+host-side concern.  The Supervisor wraps any chunk function
+(state -> state, typically a jitted ``run_ms_batched`` slice) in a loop
+
+    resume -> [guard -> chunk -> sync -> checkpoint]* -> report
+
+with:
+
+- **checkpoint/resume** through engine.checkpoint.CheckpointManager:
+  periodic numbered checkpoints + LATEST pointer, run_key-stamped so a
+  checkpoint from a different run refuses to resume
+  (ResumeMismatchError); kill-and-resume is bit-identical to an
+  uninterrupted run — including telemetry counters and fault side-cars
+  — because resume replays the exact remaining chunk schedule;
+- **watchdog**: each chunk executes in a worker thread with a deadline
+  (the first chunk of a cold process gets the compile allowance on
+  top).  A miss raises WatchdogTimeoutError rather than waiting forever
+  on a dead tunnel.  Caveat: Python cannot cancel a hung device call —
+  the worker thread leaks and the supervisor stops issuing work;
+  actually killing the process is the job of a process-level supervisor
+  (scripts/tpu_campaign.py), because killing mid-device-call wedges the
+  tunneled worker (r3/r4 lesson);
+- **retry with backoff**: transient failures (classify()) replay
+  deterministically from the last host ANCHOR — a numpy snapshot taken
+  at checkpoint cadence — so retried chunks produce the exact bytes a
+  clean run would have, even with donated device buffers (the donated
+  input that the failed call consumed is never needed again);
+- **graceful degradation**: on device loss with
+  DegradePolicy(cpu_fallback=True) the anchor is re-placed on CPU and
+  the run continues there, with {degraded, degraded_at_chunk} stamped
+  into provenance — a CPU tail can never masquerade as a TPU number;
+- **budget/cap partial stops**: budget_s / max_chunks_this_run exceeded
+  between chunks -> checkpoint now, return RunReport(ok=False) — the
+  next invocation resumes where this one stopped.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ..engine.checkpoint import CheckpointManager
+from .errors import (
+    DurableRunError,
+    FatalRunError,
+    ResumeMismatchError,
+    RetriesExhaustedError,
+    WatchdogTimeoutError,
+    classify,
+)
+from .policy import DegradePolicy, RetryPolicy, WatchdogPolicy
+
+
+def _sync(state: Any) -> None:
+    """Ground-truth chunk completion: host readback of the SMALLEST
+    output leaf (one program's outputs materialize together).
+    block_until_ready alone acks while a tunneled program is still
+    queued — see bench.chunked_pass, same trick."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(state)
+    if leaves:
+        np.asarray(min(leaves, key=lambda a: getattr(a, "size", 1 << 62)))
+
+
+def run_with_deadline(fn: Callable[[], Any], deadline_s: float, phase: str):
+    """Run fn() in a worker thread with a deadline; raise
+    WatchdogTimeoutError(phase) on a miss.  The thread is daemonic and
+    LEAKS if fn truly hangs (an uncancellable device call) — callers
+    that need the hang actually killed must supervise at process level."""
+    box: dict = {}
+
+    def worker():
+        try:
+            box["out"] = fn()
+        except BaseException as e:  # noqa: BLE001 — forwarded to caller
+            box["err"] = e
+
+    th = threading.Thread(target=worker, daemon=True, name=f"witt-{phase}")
+    th.start()
+    th.join(deadline_s)
+    if th.is_alive():
+        raise WatchdogTimeoutError(phase, deadline_s)
+    if "err" in box:
+        raise box["err"]
+    return box["out"]
+
+
+def stable_run_key(net: Any, template: Any, n_chunks: int, chunk_ms: int) -> str:
+    """A run identity that survives process restarts (unlike
+    core.cache_key, which hashes object ids): protocol type + chunk
+    geometry + the template's leaf signature (paths/shapes/dtypes)."""
+    import hashlib
+
+    import jax
+
+    proto = getattr(net, "protocol", net)
+    parts = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(template)[0]:
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", type(leaf).__name__)
+        parts.append(f"{path}:{shape}:{dtype}")
+    digest = hashlib.blake2b(
+        "|".join(parts).encode(), digest_size=8
+    ).hexdigest()
+    return f"{type(proto).__name__}:{n_chunks}x{chunk_ms}ms:{digest}"
+
+
+@dataclass
+class RunReport:
+    """What a supervised run produced.  ok=False is a CONTROLLED partial
+    stop (budget / chunk cap) with a checkpoint on disk; failures raise
+    instead."""
+
+    state: Any
+    ok: bool
+    chunk_seconds: List[float] = field(default_factory=list)
+    provenance: dict = field(default_factory=dict)
+
+    @property
+    def chunks_done(self) -> int:
+        return int(self.provenance.get("chunks_done", 0))
+
+
+class Supervisor:
+    """See module docstring.  `chunk_fn(state) -> state` advances one
+    chunk; it may be jitted with donated inputs (retries replay from the
+    host anchor, never from a consumed buffer)."""
+
+    def __init__(
+        self,
+        chunk_fn: Callable[[Any], Any],
+        template: Any,
+        *,
+        n_chunks: int,
+        chunk_ms: int = 0,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 1,
+        keep: int = 3,
+        retry: Optional[RetryPolicy] = None,
+        watchdog: Optional[WatchdogPolicy] = None,
+        degrade: Optional[DegradePolicy] = None,
+        cpu_chunk_fn: Optional[Callable[[Any], Any]] = None,
+        run_key: Optional[str] = None,
+        run_meta: Optional[dict] = None,
+        heartbeat: Optional[Callable[[int, float], None]] = None,
+        budget_s: float = float("inf"),
+        max_chunks_this_run: Optional[int] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        consume_template: bool = False,
+    ):
+        if n_chunks < 1:
+            raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
+        if checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}"
+            )
+        self.chunk_fn = chunk_fn
+        self.template = template
+        self.n_chunks = n_chunks
+        self.chunk_ms = chunk_ms
+        self.manager = (
+            CheckpointManager(checkpoint_dir, keep=keep)
+            if checkpoint_dir
+            else None
+        )
+        self.checkpoint_every = checkpoint_every
+        self.retry = retry or RetryPolicy()
+        self.watchdog = watchdog
+        self.degrade = degrade
+        self.cpu_chunk_fn = cpu_chunk_fn
+        self.run_key = run_key
+        self.run_meta = dict(run_meta or {})
+        self.heartbeat = heartbeat
+        self.budget_s = budget_s
+        self.max_chunks_this_run = max_chunks_this_run
+        self.sleep = sleep
+        self.consume_template = consume_template
+        self._first_call_done = False
+        self._degraded = False
+
+    # -- state placement ------------------------------------------------
+
+    def _snapshot(self, state: Any):
+        """Host anchor: a private numpy copy of every leaf (immune to
+        donation consuming the device buffers)."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda a: np.array(np.asarray(a), copy=True), state
+        )
+
+    def _place(self, host_state: Any) -> Any:
+        import jax
+        import jax.numpy as jnp
+
+        if self._degraded:
+            cpu = jax.devices("cpu")[0]
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, cpu), host_state
+            )
+        return jax.tree_util.tree_map(jnp.asarray, host_state)
+
+    # -- chunk execution ------------------------------------------------
+
+    def _active_chunk_fn(self) -> Callable[[Any], Any]:
+        if self._degraded and self.cpu_chunk_fn is not None:
+            return self.cpu_chunk_fn
+        return self.chunk_fn
+
+    def _run_chunk(self, state: Any) -> Any:
+        fn = self._active_chunk_fn()
+
+        def call():
+            out = fn(state)
+            _sync(out)
+            return out
+
+        if self.watchdog is None:
+            out = call()
+            self._first_call_done = True
+            return out
+        deadline = self.watchdog.chunk_deadline_s
+        phase = "chunk"
+        if not self._first_call_done:
+            deadline += self.watchdog.compile_deadline_s
+            phase = "compile+chunk"
+        out = run_with_deadline(call, deadline, phase)
+        self._first_call_done = True
+        return out
+
+    # -- resume ---------------------------------------------------------
+
+    @property
+    def _needs_anchor(self) -> bool:
+        """Host anchors exist to replay retries and seed checkpoints;
+        without either, skip them entirely — a bare supervised pass then
+        costs only the loop + sync bench's chunked_pass already paid."""
+        return self.manager is not None or self.retry.max_attempts > 1
+
+    def _resume(self):
+        """-> (device_state, start_chunk, resumed_from_step, prior_times)."""
+        if self.manager is None:
+            if self.consume_template:
+                # hand the template straight to chunk_fn (bench
+                # semantics: a donating chunk_fn consumes it — the
+                # caller passed a disposable copy); anchoring, if
+                # needed, copies it first
+                return self.template, 0, None, []
+            return self._place(self._snapshot(self.template)), 0, None, []
+        got = self.manager.restore_latest(self.template)
+        if got is None:
+            if self.consume_template:
+                return self.template, 0, None, []
+            return self._place(self._snapshot(self.template)), 0, None, []
+        state, step, manifest = got
+        meta = (manifest or {}).get("meta", {})
+        saved_key = meta.get("run_key")
+        if (
+            self.run_key is not None
+            and saved_key is not None
+            and saved_key != self.run_key
+        ):
+            raise ResumeMismatchError(
+                f"checkpoint step {step} in {self.manager.directory} "
+                f"belongs to run {saved_key!r}, not {self.run_key!r} — "
+                "point the supervisor at a fresh checkpoint_dir"
+            )
+        saved_chunk_ms = meta.get("chunk_ms")
+        if (
+            self.chunk_ms
+            and saved_chunk_ms
+            and int(saved_chunk_ms) != int(self.chunk_ms)
+        ):
+            raise ResumeMismatchError(
+                f"checkpoint step {step} was written with "
+                f"chunk_ms={saved_chunk_ms}, this run uses "
+                f"chunk_ms={self.chunk_ms} — resume would change the "
+                "chunk schedule and break bit-identity"
+            )
+        if step > self.n_chunks:
+            raise ResumeMismatchError(
+                f"checkpoint step {step} exceeds this run's "
+                f"n_chunks={self.n_chunks}"
+            )
+        prior = list(meta.get("chunk_seconds", []))
+        return self._place(self._snapshot(state)), step, step, prior
+
+    def _save(self, state: Any, step: int, times_all: List[float]) -> None:
+        meta = {
+            **self.run_meta,
+            "run_key": self.run_key,
+            "chunk_ms": self.chunk_ms,
+            "n_chunks": self.n_chunks,
+            "chunks_done": step,
+            "chunk_seconds": [round(t, 4) for t in times_all],
+            "degraded": self._degraded,
+        }
+        self.manager.save(state, step, meta=meta)
+
+    # -- the loop -------------------------------------------------------
+
+    def run(self) -> RunReport:
+        state, start_chunk, resumed_from, prior_times = self._resume()
+        anchor = self._snapshot(state) if self._needs_anchor else None
+        anchor_chunk = start_chunk
+        times: List[float] = []  # this run's completed chunks, in order
+        i = start_chunk
+        fail_streak = 0
+        retries_total = 0
+        checkpoints = 0
+        degraded_at = None
+        t_start = time.perf_counter()
+
+        def provenance(done: int) -> dict:
+            import jax
+
+            return {
+                "platform": jax.default_backend(),
+                "degraded": self._degraded,
+                "degraded_at_chunk": degraded_at,
+                "resumed_from_step": resumed_from,
+                "retries": retries_total,
+                "checkpoints": checkpoints,
+                "run_key": self.run_key,
+                "chunk_ms": self.chunk_ms,
+                "n_chunks": self.n_chunks,
+                "chunks_done": done,
+            }
+
+        while i < self.n_chunks:
+            over_budget = time.perf_counter() - t_start > self.budget_s
+            over_cap = (
+                self.max_chunks_this_run is not None
+                and len(times) >= self.max_chunks_this_run
+            )
+            if over_budget or over_cap:
+                # controlled partial stop: checkpoint NOW (even
+                # off-cadence — resumability beats cadence) and report
+                if self.manager is not None and i > anchor_chunk:
+                    self._save(state, i, prior_times + times)
+                    checkpoints += 1
+                return RunReport(
+                    state, False, times, provenance(i)
+                )
+            try:
+                t1 = time.perf_counter()
+                state = self._run_chunk(state)
+                dt = time.perf_counter() - t1
+            except BaseException as e:  # noqa: BLE001 — classified below
+                kind = classify(e)
+                if kind == "fatal":
+                    raise
+                fail_streak += 1
+                retries_total += 1
+                if fail_streak >= self.retry.max_attempts:
+                    raise RetriesExhaustedError(fail_streak, e) from e
+                if (
+                    kind == "device_lost"
+                    and self.degrade is not None
+                    and self.degrade.cpu_fallback
+                    and not self._degraded
+                ):
+                    self._degraded = True
+                    degraded_at = i
+                    self._first_call_done = False  # CPU gets a compile
+                self.sleep(self.retry.delay_s(fail_streak - 1))
+                # replay deterministically from the last anchor: the
+                # chunks between anchor_chunk and i re-run and produce
+                # the exact bytes the failed timeline would have
+                state = self._place(anchor)
+                times = times[: anchor_chunk - start_chunk]
+                i = anchor_chunk
+                continue
+            fail_streak = 0
+            times.append(dt)
+            if self.heartbeat is not None:
+                self.heartbeat(i, dt)
+            i += 1
+            at_cadence = (i - start_chunk) % self.checkpoint_every == 0
+            if at_cadence or i == self.n_chunks:
+                if self.manager is not None:
+                    self._save(state, i, prior_times + times)
+                    checkpoints += 1
+                if self._needs_anchor:
+                    anchor = self._snapshot(state)
+                    anchor_chunk = i
+        return RunReport(state, True, times, provenance(self.n_chunks))
+
+    # -- convenience ----------------------------------------------------
+
+    @classmethod
+    def from_network(
+        cls,
+        net: Any,
+        state: Any,
+        *,
+        total_ms: int,
+        chunk_ms: int,
+        batched: bool = True,
+        stop_when_done: bool = False,
+        donate: bool = False,
+        run_key: Optional[str] = None,
+        **kw,
+    ) -> "Supervisor":
+        """Build a supervisor whose chunk_fn is a jitted chunk_ms slice
+        of net.run_ms / net.run_ms_batched.
+
+        Donation is SEMANTICALLY safe under the supervisor (retries
+        replay from host anchors, never from a consumed buffer) but
+        defaults OFF: jit(donate_argnums) chunk loops corrupt the heap
+        ("corrupted double-linked list" aborts) on jaxlib 0.4.37 when
+        the persistent compilation cache is enabled together with
+        --xla_force_host_platform_device_count — exactly the tier-1 test
+        configuration.  bench's AOT `lower().compile()` donated chunk fn
+        does not exhibit this; callers that need donated buffers (TPU
+        memory pressure) should compile that way and pass chunk_fn
+        directly, or opt in here deliberately.
+
+        stop_when_done note: the early exit changes which ticks execute
+        per chunk boundary, so bit-identity of a chunked vs straight run
+        is only guaranteed for the default stop_when_done=False (the
+        done_at deliverable is preserved either way — see run_ms)."""
+        import jax
+
+        if total_ms % chunk_ms != 0:
+            raise ValueError(
+                f"total_ms={total_ms} must be a multiple of chunk_ms={chunk_ms}"
+            )
+        n_chunks = total_ms // chunk_ms
+        runner = net.run_ms_batched if batched else net.run_ms
+        chunk_fn = jax.jit(
+            lambda s: runner(s, chunk_ms, stop_when_done),
+            donate_argnums=(0,) if donate else (),
+        )
+        # the same jitted fn re-traces for CPU-placed inputs, so the
+        # degraded path reuses it (jit specializes on input placement)
+        if run_key is None:
+            run_key = stable_run_key(net, state, n_chunks, chunk_ms)
+        return cls(
+            chunk_fn,
+            state,
+            n_chunks=n_chunks,
+            chunk_ms=chunk_ms,
+            run_key=run_key,
+            **kw,
+        )

@@ -207,6 +207,9 @@ def run_fault_sweep(
     seed0: int = 0,
     stop_when_done: bool = False,
     done_cdf_every: int = 0,
+    checkpoint_dir: Optional[str] = None,
+    chunk_ms: Optional[int] = None,
+    supervisor_kw: Optional[dict] = None,
 ):
     """The fault-axis sweep: one `run_ms_batched` call where replica row
     `r` runs fault plan `plans[r // replicas_per_plan]` (None entries =
@@ -218,7 +221,17 @@ def run_fault_sweep(
 
     Every plan shares ONE compiled program: the schedules are data
     (FaultState rows), not traced branches, so sweeping crash vs
-    partition vs drop costs one jit like sweeping seeds does."""
+    partition vs drop costs one jit like sweeping seeds does.
+
+    checkpoint_dir makes the sweep RESUMABLE: the pass runs chunked
+    (chunk_ms, default 100) under runtime.Supervisor with periodic
+    checkpoints; an interrupted sweep re-invoked with the same arguments
+    resumes at its last checkpoint and produces a report bitwise-equal
+    to the uninterrupted sweep (the engine is deterministic in (state,
+    tick count); keep stop_when_done=False for the bitwise claim — the
+    early exit is chunk-boundary dependent).  A controlled partial stop
+    (supervisor_kw budget_s / max_chunks_this_run) raises
+    RunIncompleteError carrying the partial RunReport."""
     from ..engine.core import replicate_state
     from ..faults import FaultConfig
     from ..faults.plan import lower_plans
@@ -238,7 +251,35 @@ def run_fault_sweep(
     batched = replicate_state(
         fstate, n_rep, seeds=np.arange(seed0, seed0 + n_rep, dtype=np.int64)
     )._replace(faults=fs)
-    out = fnet.run_ms_batched(batched, sim_ms, stop_when_done)
+    if checkpoint_dir is not None:
+        from ..runtime import RunIncompleteError, Supervisor
+
+        cms = int(chunk_ms or min(sim_ms, 100))
+        if sim_ms % cms != 0:
+            raise ValueError(
+                f"chunk_ms={cms} must divide sim_ms={sim_ms} for a "
+                "resumable sweep"
+            )
+        sup = Supervisor.from_network(
+            fnet,
+            batched,
+            total_ms=sim_ms,
+            chunk_ms=cms,
+            stop_when_done=stop_when_done,
+            checkpoint_dir=checkpoint_dir,
+            **(supervisor_kw or {}),
+        )
+        report = sup.run()
+        if not report.ok:
+            raise RunIncompleteError(
+                f"fault sweep stopped after {report.chunks_done}/"
+                f"{sup.n_chunks} chunks (budget/cap reached); checkpoint "
+                "saved — re-invoke with the same arguments to resume",
+                report=report,
+            )
+        out = report.state
+    else:
+        out = fnet.run_ms_batched(batched, sim_ms, stop_when_done)
 
     done = np.asarray(out.done_at)
     down = np.asarray(out.down)

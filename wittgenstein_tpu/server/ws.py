@@ -33,6 +33,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Optional, Tuple
@@ -53,6 +54,17 @@ class RawResponse:
         self.content_type = content_type
 
 
+class Response:
+    """A json handler result with explicit status + headers — the busy /
+    degraded paths answer 503 with a Retry-After estimate instead of
+    blocking the client on the simulation lock."""
+
+    def __init__(self, payload, status: int = 200, headers: Optional[dict] = None):
+        self.payload = payload
+        self.status = status
+        self.headers = dict(headers or {})
+
+
 def route(method: str, pattern: str, locked: bool = True):
     """`locked=False` routes run outside the shared simulation lock (for
     handlers that build their own protocol instances, e.g. /w/sweep)."""
@@ -66,11 +78,41 @@ def route(method: str, pattern: str, locked: bool = True):
 
 
 class WServer:
-    """Routing + handler logic; one live Server per instance."""
+    """Routing + handler logic; one live Server per instance.
+
+    Durability upgrades (ISSUE 6): runMs executes in SLICES holding the
+    simulation lock per slice — other endpoints (status, metrics, nodes)
+    interleave between slices instead of starving behind a long run, and
+    POST /w/network/interrupt stops the run at the next slice boundary
+    with the state consistent (a repeat runMs RESUMES from the current
+    sim time — the DES state is durable in-process).  A second runMs
+    while one is in flight gets 503 + Retry-After (estimated from the
+    in-flight request's EMA pace), and a backend marked degraded (a
+    slice raised) answers 503 until re-init."""
+
+    #: sim-ms advanced per lock hold; interrupt/busy checks run between
+    RUN_SLICE_MS = 50
 
     def __init__(self):
         self.server = Server()
         self.lock = threading.Lock()
+        # serializes runMs only (non-blocking acquire -> 503, not queue)
+        self.run_lock = threading.Lock()
+        self.degraded = False
+        self.degraded_reason: Optional[str] = None
+        self._interrupt = threading.Event()
+        self._run_rate_s_per_ms = 1e-3  # EMA seed: 1 ms wall per sim-ms
+        self._run_started: Optional[float] = None
+        self._run_ms_total = 0
+
+    def _retry_after_s(self) -> int:
+        """Estimated seconds until the in-flight runMs finishes, from
+        the EMA pace of completed runs; >= 1 per RFC 9110 semantics."""
+        started, total = self._run_started, self._run_ms_total
+        if started is None:
+            return 1
+        remain = total * self._run_rate_s_per_ms - (time.monotonic() - started)
+        return max(1, int(remain) + 1)
 
     # -- handlers ------------------------------------------------------------
     @route("GET", r"/w/protocols")
@@ -86,20 +128,89 @@ class WServer:
     def init(self, body, name):
         params = json.loads(body) if body else None
         self.server.init(name, params)
+        # a fresh sim is a fresh backend: clear the degraded latch
+        self.degraded = False
+        self.degraded_reason = None
         return {"ok": True}
 
-    @route("POST", r"/w/network/runMs/(?P<ms>\d+)")
+    @route("POST", r"/w/network/runMs/(?P<ms>\d+)", locked=False)
     def run_ms(self, body, ms):
-        self.server.run_ms(int(ms))
-        net = self.server.protocol.network()
-        return {
-            "ok": True,
-            "time": self.server.get_time(),
-            # status payload telemetry: callers polling runMs see store
-            # pressure and send-time drops without a second request
-            "occupancy": net.occupancy(),
-            "dropped": net.dropped,
-        }
+        """Sliced, interruptible, resumable advance.  NOT under the
+        shared lock wholesale: each RUN_SLICE_MS slice takes it, so
+        status/metrics reads interleave; busy and degraded backends get
+        503 + Retry-After instead of a queued request."""
+        ms = int(ms)
+        if self.degraded:
+            return Response(
+                {
+                    "error": f"backend degraded: {self.degraded_reason}",
+                    "degraded": True,
+                },
+                503,
+                {"Retry-After": "30"},
+            )
+        if not self.run_lock.acquire(blocking=False):
+            return Response(
+                {"error": "a runMs is already in progress", "busy": True},
+                503,
+                {"Retry-After": str(self._retry_after_s())},
+            )
+        try:
+            self._interrupt.clear()
+            self._run_started = time.monotonic()
+            self._run_ms_total = ms
+            done = 0
+            t0 = time.monotonic()
+            try:
+                while done < ms:
+                    step = min(self.RUN_SLICE_MS, ms - done)
+                    with self.lock:
+                        self.server.run_ms(step)
+                    done += step
+                    if self._interrupt.is_set() and done < ms:
+                        break
+            except RuntimeError:
+                raise  # uninitialized server (409) — not a backend fault
+            except Exception as e:
+                # a slice blew up mid-run: latch degraded so clients get
+                # an honest 503 (with the reason) until the operator
+                # re-inits, instead of racing a broken sim
+                self.degraded = True
+                self.degraded_reason = f"{type(e).__name__}: {e}"
+                raise
+            dt = time.monotonic() - t0
+            if done:
+                self._run_rate_s_per_ms = (
+                    0.5 * self._run_rate_s_per_ms + 0.5 * dt / done
+                )
+            with self.lock:
+                net = self.server.protocol.network()
+                return {
+                    # ok=False + interrupted: a repeat runMs with the
+                    # remaining ms RESUMES — sim state is consistent at
+                    # every slice boundary
+                    "ok": done == ms,
+                    "ranMs": done,
+                    "requestedMs": ms,
+                    "interrupted": done < ms,
+                    "time": self.server.get_time(),
+                    # status payload telemetry: callers polling runMs see
+                    # store pressure and send-time drops without a second
+                    # request
+                    "occupancy": net.occupancy(),
+                    "dropped": net.dropped,
+                }
+        finally:
+            self._run_started = None
+            self.run_lock.release()
+
+    @route("POST", r"/w/network/interrupt", locked=False)
+    def interrupt(self, body):
+        """Stop an in-flight runMs at its next slice boundary.  Always
+        safe: the flag is cleared when the next runMs starts."""
+        running = self.run_lock.locked()
+        self._interrupt.set()
+        return {"ok": True, "running": running}
 
     @route("GET", r"/w/network/time")
     def get_time(self, body):
@@ -107,7 +218,11 @@ class WServer:
 
     @route("GET", r"/w/network/status")
     def status(self, body):
-        return self.server.get_status()
+        s = self.server.get_status()
+        s["degraded"] = self.degraded
+        if self.degraded_reason:
+            s["degradedReason"] = self.degraded_reason
+        return s
 
     @route("GET", r"/metrics")
     def metrics(self, body):
@@ -211,7 +326,10 @@ class WServer:
 
     def _invoke(self, fn, body, kwargs) -> Tuple[int, object]:
         try:
-            return 200, fn(self, body, **kwargs)
+            out = fn(self, body, **kwargs)
+            if isinstance(out, Response):
+                return out.status, out
+            return 200, out
         except (KeyError, ValueError, TypeError, AttributeError) as e:
             return 400, {"error": f"{type(e).__name__}: {e}"}
         except RuntimeError as e:
@@ -223,10 +341,14 @@ class WServer:
 class _Handler(BaseHTTPRequestHandler):
     ws: WServer  # set by serve()
 
-    def _respond(self, status: int, content_type: str, data: bytes):
+    def _respond(
+        self, status: int, content_type: str, data: bytes, headers=None
+    ):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -248,6 +370,14 @@ class _Handler(BaseHTTPRequestHandler):
         status, payload = self.ws.dispatch(method, self.path, body)
         if isinstance(payload, RawResponse):
             self._respond(status, payload.content_type, payload.body.encode())
+            return
+        if isinstance(payload, Response):
+            self._respond(
+                status,
+                "application/json",
+                json.dumps(payload.payload).encode(),
+                payload.headers,
+            )
             return
         self._respond(status, "application/json", json.dumps(payload).encode())
 
