@@ -73,47 +73,25 @@ PROBE_ATTEMPTS = 2
 PROBE_TIMEOUT_S = 120
 
 
-def _probe_cache_path() -> str:
-    """Per-process-tree probe-verdict cache in /tmp: keyed by uid +
-    session id so a bench ladder (parent + --rung subprocesses + helper
-    scripts) probes the backend ONCE instead of burning PROBE_ATTEMPTS x
-    PROBE_TIMEOUT_S in every child when the tunnel is dead."""
-    import tempfile
-
-    try:
-        scope = os.getsid(0)
-    except (AttributeError, OSError):  # non-POSIX / detached
-        scope = os.getppid()
-    return os.path.join(
-        tempfile.gettempdir(), f"witt_bench_probe_{os.getuid()}_{scope}.json"
-    )
-
-
-# cached verdicts older than this are stale (a tunnel can come back)
-PROBE_CACHE_TTL_S = 3600
+# The TTL'd probe-verdict cache moved to profiling.probe (r11) so the
+# server's /metrics and run records can read the verdict without
+# importing this module; these aliases keep the bench-local names the
+# helper scripts grew up with.  Importing profiling pulls NO jax.
+from wittgenstein_tpu.profiling.probe import (  # noqa: E402
+    PROBE_CACHE_TTL_S,
+    probe_cache_path as _probe_cache_path,
+    probe_verdict_fields,
+    read_probe_cache,
+    write_probe_cache,
+)
 
 
 def _read_probe_cache(path: str):
-    try:
-        with open(path) as f:
-            cached = json.load(f)
-        if time.time() - float(cached.get("ts", 0)) > PROBE_CACHE_TTL_S:
-            return None
-        if not cached.get("platform"):
-            return None
-        return cached
-    except (OSError, ValueError):
-        return None
+    return read_probe_cache(path)
 
 
 def _write_probe_cache(path: str, verdict: dict) -> None:
-    tmp = f"{path}.{os.getpid()}.tmp"
-    try:
-        with open(tmp, "w") as f:
-            json.dump({**verdict, "ts": time.time()}, f)
-        os.replace(tmp, path)  # atomic: concurrent rungs see old or new
-    except OSError:
-        pass  # cache is an optimization, never a failure
+    write_probe_cache(verdict, path)
 
 
 def _probe_backend() -> dict:
@@ -208,18 +186,11 @@ def probe_worker_healthy(timeout_s: int = PROBE_TIMEOUT_S) -> bool:
 
 
 def _params(node_ct: int):
-    from wittgenstein_tpu.protocols.handel import HandelParameters
+    # ONE definition of the flagship config, shared with the ablation
+    # matrix and budget_report (profiling.ablation.flagship_params)
+    from wittgenstein_tpu.profiling import flagship_params
 
-    return HandelParameters(
-        node_count=node_ct,
-        threshold=int(node_ct * 0.99),
-        pairing_time=3,
-        level_wait_time=50,
-        extra_cycle=10,
-        dissemination_period_ms=10,
-        fast_path=10,
-        nodes_down=0,
-    )
+    return flagship_params(node_ct)
 
 
 def bench_oracle(node_ct: int) -> float:
@@ -266,6 +237,8 @@ def chunked_pass(
     run_meta=None,
     chunk_ms=None,
     checkpoint_every=1,
+    tracer=None,
+    on_report=None,
 ):
     """One budgeted chunked pass over an AOT executable — THE shared
     never-kill-mid-call loop (bench ladder + scripts/tpu_campaign.py both
@@ -280,6 +253,10 @@ def chunked_pass(
     called after every chunk so a supervisor watching file mtime can
     tell a long healthy pass from a wedged worker.  Returns
     (out, times, ok) — `times` covers this invocation's chunks only.
+    `tracer` (a telemetry SpanTracer) records per-chunk spans and
+    retry/degrade instants; `on_report(RunReport)` hands the caller the
+    full report — provenance now carries the per-chunk wall-time
+    histogram and watchdog/retry counters (ISSUE-7d).
 
     `compiled` may be jitted with donate_argnums — the supervisor only
     ever feeds each chunk's OUTPUT to the next chunk, so donation is
@@ -302,8 +279,11 @@ def chunked_pass(
         heartbeat=heartbeat,
         budget_s=budget_s,
         consume_template=True,
+        tracer=tracer,
     )
     rep = sup.run()
+    if on_report is not None:
+        on_report(rep)
     return rep.state, [round(t, 2) for t in rep.chunk_seconds], rep.ok
 
 
@@ -350,8 +330,8 @@ def bench_batched(node_ct: int, n_replicas: int, budget_s: float = 1e9) -> dict:
 
         return jax.tree_util.tree_map(jnp.copy, states)
 
-    def run_chunked(st, budget):
-        return chunked_pass(compiled, st, n_chunks, budget)
+    def run_chunked(st, budget, **kw):
+        return chunked_pass(compiled, st, n_chunks, budget, **kw)
 
     def _partial(times):
         per_tick_s = sum(times) / (len(times) * chunk_ms)
@@ -382,10 +362,14 @@ def bench_batched(node_ct: int, n_replicas: int, budget_s: float = 1e9) -> dict:
     tracer.add_span("compile", 0.0, compile_s * 1e6, nodes=node_ct)
 
     profile_dir = os.environ.get("WITT_BENCH_PROFILE")
+    reports = []
     with trace(profile_dir) if profile_dir else contextlib.nullcontext():
         t0 = time.perf_counter()
         with tracer.span("timed_pass", replicas=n_replicas):
-            out, chunk_times, ok = run_chunked(_fresh_states(), pass_budget)
+            out, chunk_times, ok = run_chunked(
+                _fresh_states(), pass_budget,
+                tracer=tracer, on_report=reports.append,
+            )
         run_s = time.perf_counter() - t0
     if not ok:
         return _partial(chunk_times)
@@ -397,6 +381,9 @@ def bench_batched(node_ct: int, n_replicas: int, budget_s: float = 1e9) -> dict:
         "compile_s": round(compile_s, 1),
         "run_s": round(run_s, 3),
         "chunk_ms": chunk_ms,
+        # supervisor provenance of the timed pass: per-chunk wall-time
+        # histogram + retry/watchdog/degrade counters (ISSUE-7d)
+        "supervisor": reports[-1].provenance if reports else None,
         # worst single device call — the ladder projects the NEXT rung's
         # chunk time from this before climbing (watchdog safety)
         "max_chunk_s": max(chunk_times) if chunk_times else 0.0,
@@ -412,24 +399,34 @@ def phase_profile(
     n_replicas: int = 2,
     scans: int = 25,
     trace_path: "str | None" = None,
+    ablate: bool = True,
+    repeats: int = 3,
+    ablation_levers: "list | None" = None,
 ) -> dict:
-    """Per-phase tick cost + wheel occupancy high-water marks, reported
-    into the BENCH json so future rounds can see where ticks go.
+    """Per-phase tick cost + wheel occupancy high-water marks + the
+    config-ablation lever report, reported into the BENCH json so
+    future rounds can see where ticks go.
 
-    Two probes:
+    Three probes:
       * handel (the bench rung): each tick phase — delivery, emission
         apply, protocol tick, beat — scanned `scans` times in isolation
         (phases overlap by construction: delivery is part of the full
         step, so shares are an op-cost ranking, not a partition);
       * pingpong at 1x and 8x ring capacity: the same delivery phase —
         with the time wheel its cost tracks the VIEW (window*B + V), not
-        the total capacity C, and the two numbers should be ~equal.
+        the total capacity C, and the two numbers should be ~equal;
+      * the ablation matrix (profiling.ablation, `ablate=True`): full
+        steps of channel_depth_8 / boundary_view_off / pre_r5 / wheel /
+        telemetry_on / faults_on / annotations_off vs base, ranked by
+        per-tick delta — the r4→r5 regression attributed to named
+        levers, and the named-scope annotation overhead bound.
     Occupancy high-water (wheel row fill / overflow lane census) comes
     from the engine's instrumented run (run_ms_occupancy).
 
     The timing loop is the telemetry span-tracer harness
-    (telemetry.phases — shared with scripts/phase_profile.py); pass
-    trace_path to keep the Chrome-trace JSON of the measurement."""
+    (telemetry.phases — shared with scripts/phase_profile.py),
+    warmup-discarded with per-phase mean+stddev; pass trace_path to
+    keep the Chrome-trace JSON of the measurement."""
     import jax
 
     from wittgenstein_tpu.engine import replicate_state
@@ -448,7 +445,8 @@ def phase_profile(
     states = replicate_state(state, n_replicas)
     states = net.run_ms_batched(states, 120)  # realistic channel occupancy
     jax.block_until_ready(states)
-    t = scan_phase_seconds(states, engine_phase_fns(net), scans, tracer)
+    stats = scan_phase_seconds(states, engine_phase_fns(net), scans, tracer)
+    t = {k: v["mean_s"] for k, v in stats.items()}
     r3 = lambda x: round(x * 1e3, 3)
     phases = {
         "full_step_ms": r3(t["full_step"]),
@@ -456,6 +454,7 @@ def phase_profile(
         "emission_apply_ms": r3(max(0.0, t["deliver_apply"] - t["delivery"])),
         "protocol_tick_ms": r3(t["protocol_tick"]),
         "beat_ms": r3(t["beat"]),
+        "stddev_ms": {k: r3(v["std_s"]) for k, v in stats.items()},
     }
     _, occ = net.run_ms_occupancy(state, 300)
     occupancy = {k: int(v) for k, v in occ.items()}
@@ -468,7 +467,7 @@ def phase_profile(
         pstates = replicate_state(pstate, n_replicas)
         dt = scan_phase_seconds(
             pstates, {"delivery": pnet._phase_deliver}, scans, tracer
-        )["delivery"]
+        )["delivery"]["mean_s"]
         pn, pocc = pnet.run_ms_occupancy(pstate, 150)
         scaling.append(
             {
@@ -480,6 +479,19 @@ def phase_profile(
                 "overflow_hwm": int(pocc["overflow_hwm"]),
             }
         )
+    ablation = None
+    if ablate:
+        from wittgenstein_tpu.profiling import ablation_matrix, lever_report
+
+        matrix = ablation_matrix(
+            node_ct,
+            n_replicas,
+            scans=scans,
+            repeats=repeats,
+            levers=ablation_levers,
+            tracer=tracer,
+        )
+        ablation = {"matrix": matrix, "report": lever_report(matrix)}
     if trace_path:
         tracer.write(trace_path)
     return {
@@ -488,6 +500,7 @@ def phase_profile(
         "handel_phases": phases,
         "handel_occupancy": occupancy,
         "pingpong_delivery_vs_capacity": scaling,
+        "ablation": ablation,
     }
 
 
@@ -591,16 +604,36 @@ def _run_rung(node_ct: int, n_replicas: int, budget_s: float, timeout_s: int) ->
 # test_stop_when_done tests), but traffic counters exclude post-done
 # dissemination the oracle would still count
 # ROADMAP item-1 north star: 21 sims/s/chip at the flagship node count.
-# One sim = SIM_MS ticks, so at R replicas/batch the whole batch must
-# average R / (21 * SIM_MS) seconds per tick — the chip-independent
+# One sim = ticks_per_sim EXECUTED ticks (SIM_MS when nothing quiesces;
+# less with the stop_when_done early exit — BUDGET.json records the
+# measured value), so at R replicas/batch the whole batch must average
+# R / (21 * ticks_per_sim) seconds per tick — the chip-independent
 # per-tick budget every rung is judged against.
 NORTH_STAR_SIMS_PER_SEC = 21.0
 
 
+def _budget_ticks_per_sim() -> float:
+    """Measured ticks/sim from BUDGET.json (scripts/budget_report.py);
+    SIM_MS — the no-quiescence worst case — when no budget exists."""
+    from wittgenstein_tpu.profiling import load_budget
+
+    budget = load_budget(
+        root=os.path.dirname(os.path.abspath(__file__))
+    )
+    if budget and float(budget.get("ticks_per_sim") or 0) > 0:
+        return float(budget["ticks_per_sim"])
+    return float(SIM_MS)
+
+
 def target_tick_us(n_replicas: int) -> float:
     """Per-tick wall budget (µs) for the north-star throughput at this
-    replica count (e.g. ~6095 µs at R=128)."""
-    return n_replicas / (NORTH_STAR_SIMS_PER_SEC * SIM_MS) * 1e6
+    replica count — DERIVED from BUDGET.json's measured ticks/sim (the
+    profiling.budget arithmetic), not hand-set."""
+    from wittgenstein_tpu.profiling import required_tick_us
+
+    return required_tick_us(
+        n_replicas, _budget_ticks_per_sim(), NORTH_STAR_SIMS_PER_SEC
+    )
 
 
 def _floor_path() -> str:
@@ -734,8 +767,11 @@ def _headline(
         },
         "compile_s": result.get("compile_s"),
         "run_s": result.get("run_s"),
-        # chip-independent per-tick budget (ROADMAP item 1) vs measured
+        # chip-independent per-tick budget (ROADMAP item 1) vs measured;
+        # the target derives from BUDGET.json's measured ticks/sim
+        # (profiling.budget) — falls back to SIM_MS when absent
         "target_tick_us": round(target_tick_us(n_replicas), 1),
+        "budget_ticks_per_sim": round(_budget_ticks_per_sim(), 1),
         "measured_tick_us": (
             round(result["run_s"] / SIM_MS * 1e6, 1)
             if result.get("run_s")
@@ -758,6 +794,9 @@ def _headline(
             " CPU replica ladder.  Not comparable to the r1/r2 lite engine"
         ),
         "probe": probe,
+        # flat verdict fields (attempts / last rc / fallback / cache age)
+        # so dead-tunnel fallbacks are visible without reading raw tails
+        "probe_verdict": probe_verdict_fields(probe),
         "bench_error": bench_error,
     }
 
@@ -951,7 +990,9 @@ def main() -> None:
     # are watchdog exposure)
     if platform != "tpu" or os.environ.get("WITT_BENCH_PHASE_PROFILE") == "1":
         try:
-            rec["phase_profile"] = phase_profile()
+            # ablation matrix off here: 8 fresh configs are minutes of
+            # compile on the 1-core box — --phase-profile runs it
+            rec["phase_profile"] = phase_profile(ablate=False)
         except Exception as e:
             rec["phase_profile"] = {
                 "error": f"{type(e).__name__}: {str(e)[:300]}"
@@ -992,22 +1033,37 @@ if __name__ == "__main__":
         sys.exit(0 if rec["ok"] else 1)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--phase-profile":
         # standalone microbenchmark mode: per-phase wall time + wheel
-        # occupancy high-water, one JSON line (CPU by default — pass
-        # WITT_BENCH_PLATFORM=tpu to profile the chip deliberately)
+        # occupancy high-water + the ranked ablation lever report, one
+        # JSON line on stdout, the human lever table on stderr (CPU by
+        # default — pass WITT_BENCH_PLATFORM=tpu to profile the chip
+        # deliberately).  Args: [node_ct] [replicas] [scans].
+        # WITT_BENCH_ABLATION=smoke restricts the matrix to the r4→r5
+        # attribution levers (the CI tier); =off skips it.
         import jax
 
         if os.environ.get("WITT_BENCH_PLATFORM", "cpu") != "tpu":
             jax.config.update("jax_platforms", "cpu")
         node_ct = int(sys.argv[2]) if len(sys.argv) > 2 else 256
         n_replicas = int(sys.argv[3]) if len(sys.argv) > 3 else 2
-        print(
-            json.dumps(
-                phase_profile(
-                    node_ct,
-                    n_replicas,
-                    trace_path=os.environ.get("WITT_BENCH_TRACE"),
-                )
-            )
+        scans = int(sys.argv[4]) if len(sys.argv) > 4 else 25
+        ablate_mode = os.environ.get("WITT_BENCH_ABLATION", "full")
+        levers = None
+        if ablate_mode == "smoke":
+            from wittgenstein_tpu.profiling import smoke_ablation_configs
+
+            levers = smoke_ablation_configs()
+        rec = phase_profile(
+            node_ct,
+            n_replicas,
+            scans,
+            trace_path=os.environ.get("WITT_BENCH_TRACE"),
+            ablate=ablate_mode != "off",
+            ablation_levers=levers,
         )
+        print(json.dumps(rec))
+        if rec.get("ablation"):
+            from wittgenstein_tpu.profiling.ablation import format_lever_report
+
+            print(format_lever_report(rec["ablation"]["report"]), file=sys.stderr)
     else:
         main()

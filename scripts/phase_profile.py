@@ -65,11 +65,15 @@ def main() -> None:
         "select": lambda s: proto._select(net, s),
     }
     t = scan_phase_seconds(states, phases, scans, tracer)
-    full = t["full step"]
+    full = t["full step"]["mean_s"]
     print(f"\nHandel {nodes}x{replicas}, scan x{scans}, backend={jax.default_backend()}")
-    print(f"{'phase':<18} {'ms/iter':>8} {'share':>6}")
+    print(f"{'phase':<18} {'ms/iter':>8} {'±std':>6} {'share':>6}")
     for name in phases:
-        print(f"{name:<18} {t[name]*1e3:>8.1f} {t[name]/full*100:>5.0f}%")
+        s = t[name]
+        print(
+            f"{name:<18} {s['mean_s']*1e3:>8.1f} {s['std_s']*1e3:>6.2f}"
+            f" {s['mean_s']/full*100:>5.0f}%"
+        )
     trace_path = os.environ.get("WITT_PROFILE_TRACE")
     if trace_path:
         print(f"trace -> {tracer.write(trace_path)}")

@@ -1,6 +1,6 @@
 """simlint command line: `python -m wittgenstein_tpu.analysis [opts]`.
 
-Runs up to five passes and prints findings as `path:line: RULE [sev] msg`
+Runs up to six passes and prints findings as `path:line: RULE [sev] msg`
 (or JSONL with --format json):
 
   1. AST lint over every wittgenstein_tpu/*.py  (SL1xx/SL2xx)
@@ -8,9 +8,10 @@ Runs up to five passes and prints findings as `path:line: RULE [sev] msg`
   3. abstract-eval contract checks              (SL401-SL404)
   4. beat RNG audit                             (SL405)
   5. checkpoint completeness                    (SL501)
+  6. phase-annotation presence + neutrality     (SL601)
 
 Exit status: 0 when clean; 1 when any ERROR finding (or, with --strict,
-any finding at all) survives suppression; 2 on usage errors.  Passes 3-5
+any finding at all) survives suppression; 2 on usage errors.  Passes 3-6
 build every registered protocol and trace real kernels, so they take tens
 of seconds — `--skip-contracts` runs just the fast text-level passes.
 """
@@ -77,6 +78,7 @@ def run(root: str, skip_contracts: bool = False,
         # pin the platform BEFORE anything imports jax: the contract
         # passes must run identically on a CPU-only CI box
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from .annotations_check import check_annotations
         from .checkpoint_check import check_checkpoints
         from .contracts import check_all
         from .rng_audit import audit_all
@@ -96,6 +98,7 @@ def run(root: str, skip_contracts: bool = False,
         findings += check_all(root=root, names=protocols)
         findings += audit_all(root=root, names=protocols)
         findings += check_checkpoints(root=root, names=protocols)
+        findings += check_annotations(root=root, names=protocols)
     return findings
 
 

@@ -70,6 +70,10 @@ RULES: Dict[str, str] = {
     "save_state (and not declared in EPHEMERAL_LEAVES), an "
     "EPHEMERAL_LEAVES declaration is stale, or save/load does not "
     "roundtrip bitwise",
+    # -- phase annotations ----------------------------------------------------
+    "SL601": "engine phase annotations: a live kernel phase is missing its "
+    "named-scope marker in the step jaxpr, or annotations are not "
+    "bit-neutral (annotate=False twin diverges)",
 }
 
 

@@ -40,6 +40,7 @@ delivery kernels are layout-agnostic.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 from typing import Any, Callable, NamedTuple, Optional
@@ -74,6 +75,26 @@ INT_MAX = np.int32(2**31 - 1)
 # timeouts) spill to the overflow lane, which stays exact — the wheel is
 # a fast path, never a correctness boundary
 DEFAULT_WHEEL_ROWS = 512
+
+# named-scope phase map (docs/profiling.md): every engine phase is wrapped
+# in jax.named_scope so jaxprs, HLO metadata and device profiles attribute
+# ops to the phase that traced them.  Scopes are TRACE-TIME metadata only —
+# they cannot change a single computed bit (simlint SL601 pins this with a
+# concrete annotate-on vs annotate-off bitwise cross-check).  Sub-phases
+# nest (e.g. a fault send check inside the send path shows up as
+# "witt.send/witt.faults.send"), so consumers should substring-match.
+ENGINE_PHASE_SCOPES = {
+    "delivery": "witt.delivery",
+    "protocol_deliver": "witt.protocol_deliver",
+    "send": "witt.send",
+    "protocol_tick": "witt.protocol_tick",
+    "beat": "witt.beat",
+    "post": "witt.post",
+    "telemetry": "witt.telemetry",
+    "jump": "witt.jump",
+    "faults_send": "witt.faults.send",
+    "faults_deliver": "witt.faults.deliver",
+}
 
 
 class SimState(NamedTuple):
@@ -181,6 +202,7 @@ class BatchedNetwork:
         overflow_capacity: Optional[int] = None,
         telemetry: Optional[TelemetryConfig] = None,
         faults: Optional["FaultConfig"] = None,
+        annotate: bool = True,
     ):
         self.protocol = protocol
         self.latency = latency
@@ -188,6 +210,12 @@ class BatchedNetwork:
         self.capacity = capacity
         self.msg_discard_time = msg_discard_time
         self.throughput = throughput
+        # STATIC switch for the named-scope phase annotations (see
+        # ENGINE_PHASE_SCOPES): True wraps every phase in jax.named_scope
+        # (trace-time metadata, zero runtime ops); False traces the bare
+        # program — kept only so simlint SL601 can prove the two are
+        # bit-identical and bench can price the (nominally zero) overhead
+        self.annotate = bool(annotate)
         # STATIC switch: None compiles the exact pre-telemetry program
         # (state.tele is an empty pytree); a TelemetryConfig threads the
         # counter side-car through every send/deliver/jump site below
@@ -329,7 +357,15 @@ class BatchedNetwork:
             id(mesh) if mesh is not None else None,
             self.telemetry.key() if self.telemetry is not None else None,
             self.faults.key() if self.faults is not None else None,
+            self.annotate,
         )
+
+    def _scope(self, name: str):
+        """jax.named_scope for engine phase `name` (ENGINE_PHASE_SCOPES)
+        when annotation is on; a no-op context otherwise."""
+        if self.annotate:
+            return jax.named_scope(ENGINE_PHASE_SCOPES[name])
+        return contextlib.nullcontext()
 
     def with_telemetry(
         self, state: SimState, telemetry: TelemetryConfig
@@ -472,49 +508,51 @@ class BatchedNetwork:
             # and lat_f == lat, so ok/arrival are bit-identical — the
             # SL406 contract.  The drop draw uses its own hash32 stream
             # without advancing send_ctr, leaving base RNG untouched.
-            fs = state.faults
-            mrows = jnp.broadcast_to(mtype, mask.shape).astype(jnp.int32)
-            lat_f = inflate_latency(
-                self.faults, fs, state.time, from_idx, mrows, lat
-            )
-            supp = send_suppress(
-                self.faults, fs, state.time, from_idx, to_idx, mrows,
-                state.seed, state.send_ctr, send_time,
-            )
-            ok_f = (
-                mask
-                & ~state.down[from_idx]
-                & ~state.down[to_idx]
-                & (pid_f == pid_t)
-                & ~supp
-                & (lat_f < self.msg_discard_time)
-            )
-            state = state._replace(
-                faults=fs._replace(
-                    dropped_by_fault=count_by_type(
-                        fs.dropped_by_fault, ok & supp, mrows
-                    ),
-                    delayed_by_fault=count_by_type(
-                        fs.delayed_by_fault, ok_f & (lat_f != lat), mrows
-                    ),
+            with self._scope("faults_send"):
+                fs = state.faults
+                mrows = jnp.broadcast_to(mtype, mask.shape).astype(jnp.int32)
+                lat_f = inflate_latency(
+                    self.faults, fs, state.time, from_idx, mrows, lat
                 )
-            )
-            ok = ok_f
-            arrival = jnp.asarray(send_time, jnp.int32) + lat_f
+                supp = send_suppress(
+                    self.faults, fs, state.time, from_idx, to_idx, mrows,
+                    state.seed, state.send_ctr, send_time,
+                )
+                ok_f = (
+                    mask
+                    & ~state.down[from_idx]
+                    & ~state.down[to_idx]
+                    & (pid_f == pid_t)
+                    & ~supp
+                    & (lat_f < self.msg_discard_time)
+                )
+                state = state._replace(
+                    faults=fs._replace(
+                        dropped_by_fault=count_by_type(
+                            fs.dropped_by_fault, ok & supp, mrows
+                        ),
+                        delayed_by_fault=count_by_type(
+                            fs.delayed_by_fault, ok_f & (lat_f != lat), mrows
+                        ),
+                    )
+                )
+                ok = ok_f
+                arrival = jnp.asarray(send_time, jnp.int32) + lat_f
         if self.telemetry is not None:
             # the latency kernel is the one choke point EVERY send crosses
             # (generic store and the agg protocols' channel commits alike),
             # so per-mtype traffic is counted here, not in apply_emission
-            mrows = jnp.broadcast_to(mtype, mask.shape).astype(jnp.int32)
-            tele = state.tele
-            state = state._replace(
-                tele=tele._replace(
-                    lat_sent=count_by_type(tele.lat_sent, ok, mrows),
-                    lat_filtered=count_by_type(
-                        tele.lat_filtered, mask & ~ok, mrows
-                    ),
+            with self._scope("telemetry"):
+                mrows = jnp.broadcast_to(mtype, mask.shape).astype(jnp.int32)
+                tele = state.tele
+                state = state._replace(
+                    tele=tele._replace(
+                        lat_sent=count_by_type(tele.lat_sent, ok, mrows),
+                        lat_filtered=count_by_type(
+                            tele.lat_filtered, mask & ~ok, mrows
+                        ),
+                    )
                 )
-            )
         return state, ok, arrival
 
     def apply_emission(self, state: SimState, em: Emission) -> SimState:
@@ -525,6 +563,10 @@ class BatchedNetwork:
         repacked) at delivery, so the next free slot is whl_fill[row] plus
         this call's same-row rank.  Only a genuinely full store drops, and
         it drops the NEW rows, counted in `dropped`."""
+        with self._scope("send"):
+            return self._apply_emission_impl(state, em)
+
+    def _apply_emission_impl(self, state: SimState, em: Emission) -> SimState:
         k = em.mask.shape[0]
         send_time = em.send_time if em.send_time is not None else state.time + 1
         mask = em.mask
@@ -634,22 +676,23 @@ class BatchedNetwork:
             # overflow) or dropped (to_ovf & ~ofits — the rows behind the
             # scalar `overwritten` above), so sent - dropped rows are live.
             # HWMs sample post-insert, the only moment occupancy can peak.
-            tele = state.tele
-            state = state._replace(
-                tele=tele._replace(
-                    sent=count_by_type(tele.sent, ok, mtype_rows),
-                    dropped=count_by_type(
-                        tele.dropped, to_ovf & ~ofits, mtype_rows
-                    ),
-                    wheel_fill_hwm=jnp.maximum(
-                        tele.wheel_fill_hwm, jnp.max(state.whl_fill)
-                    ),
-                    ovf_hwm=jnp.maximum(
-                        tele.ovf_hwm,
-                        jnp.sum(state.ovf_valid.astype(jnp.int32)),
-                    ),
+            with self._scope("telemetry"):
+                tele = state.tele
+                state = state._replace(
+                    tele=tele._replace(
+                        sent=count_by_type(tele.sent, ok, mtype_rows),
+                        dropped=count_by_type(
+                            tele.dropped, to_ovf & ~ofits, mtype_rows
+                        ),
+                        wheel_fill_hwm=jnp.maximum(
+                            tele.wheel_fill_hwm, jnp.max(state.whl_fill)
+                        ),
+                        ovf_hwm=jnp.maximum(
+                            tele.ovf_hwm,
+                            jnp.sum(state.ovf_valid.astype(jnp.int32)),
+                        ),
+                    )
                 )
-            )
         return state
 
     def apply_emissions(self, state: SimState, emissions) -> SimState:
@@ -717,10 +760,11 @@ class BatchedNetwork:
             # suppression mask rides in ctx so _deliver_and_clear can
             # count the rows; they still leave the store like any other
             # due row (the store invariant is fault-agnostic).
-            fault_supp = due & deliver_suppress(
-                self.faults, state.faults, t, view_from, view_to
-            )
-            deliver = deliver & ~fault_supp
+            with self._scope("faults_deliver"):
+                fault_supp = due & deliver_suppress(
+                    self.faults, state.faults, t, view_from, view_to
+                )
+                deliver = deliver & ~fault_supp
         else:
             fault_supp = None
 
@@ -740,6 +784,10 @@ class BatchedNetwork:
         lane), update receiver counters, run protocol.deliver on the view,
         then clear delivered entries and repack the visited rows to a dense
         prefix.  Returns (state, emissions)."""
+        with self._scope("delivery"):
+            return self._deliver_and_clear_impl(state)
+
+    def _deliver_and_clear_impl(self, state: SimState):
         vview, due, deliver, ctx = self.delivery_view(state)
         rows, wv, wa, wf, wt, wk, wp, q, b, fault_supp = ctx
         view_to = vview.msg_to
@@ -759,15 +807,18 @@ class BatchedNetwork:
             # due rows leave the store exactly once, as delivered or as
             # delivery-time discards (down dest / cross-partition) — the
             # split the store invariant needs
-            tele = state.tele
-            state = state._replace(
-                tele=tele._replace(
-                    delivered=count_by_type(tele.delivered, deliver, view_type),
-                    discarded=count_by_type(
-                        tele.discarded, due & ~deliver, view_type
-                    ),
+            with self._scope("telemetry"):
+                tele = state.tele
+                state = state._replace(
+                    tele=tele._replace(
+                        delivered=count_by_type(
+                            tele.delivered, deliver, view_type
+                        ),
+                        discarded=count_by_type(
+                            tele.discarded, due & ~deliver, view_type
+                        ),
+                    )
                 )
-            )
         if self.faults is not None:
             # delivery-time fault discards (crashed destination / active
             # partition window); telemetry already folded them into
@@ -792,7 +843,8 @@ class BatchedNetwork:
             msg_type=vview.msg_type,
             msg_payload=vview.msg_payload,
         )
-        pstate, emissions = self.protocol.deliver(self, vstate, deliver)
+        with self._scope("protocol_deliver"):
+            pstate, emissions = self.protocol.deliver(self, vstate, deliver)
 
         # clear due entries; surviving entries (a row visited early by a
         # quantum window) repack to the slot prefix so whl_fill stays the
@@ -832,7 +884,8 @@ class BatchedNetwork:
         tick_beat separately with a real branch."""
         state, emissions = self._deliver_and_clear(state)
         state = self.apply_emissions(state, emissions)
-        return self.protocol.tick(self, state)
+        with self._scope("protocol_tick"):
+            return self.protocol.tick(self, state)
 
     # -- phase hooks (bench --phase-profile) ---------------------------------
     def _phase_deliver(self, state: SimState) -> SimState:
@@ -852,15 +905,18 @@ class BatchedNetwork:
         BEFORE the time advance, from both run paths)."""
         if self.telemetry is None:
             return state
-        tele = state.tele._replace(ticks=state.tele.ticks + 1)
-        if self.telemetry.snapshots:
-            tele = record_snapshot(tele, self.telemetry, state)
-        return state._replace(tele=tele)
+        with self._scope("telemetry"):
+            tele = state.tele._replace(ticks=state.tele.ticks + 1)
+            if self.telemetry.snapshots:
+                tele = record_snapshot(tele, self.telemetry, state)
+            return state._replace(tele=tele)
 
     def step(self, state: SimState) -> SimState:
         state = self._step_core(state)
-        state = self.protocol.tick_beat(self, state)
-        state = self.protocol.tick_post(self, state)
+        with self._scope("beat"):
+            state = self.protocol.tick_beat(self, state)
+        with self._scope("post"):
+            state = self.protocol.tick_post(self, state)
         state = self._tele_tick(state)
         return state._replace(time=state.time + 1)
 
@@ -910,31 +966,33 @@ class BatchedNetwork:
         step (each delayed < quantum ms)."""
         state = self.step(state)
         if self.protocol.TICK_INTERVAL is None:
-            q = self.protocol.TIME_QUANTUM
-            ovf_next = jnp.min(
-                jnp.where(state.ovf_valid, state.ovf_arrival, INT_MAX)
-            )
-            if self.flat:
-                next_arrival = ovf_next
-            else:
-                next_arrival = jnp.minimum(
-                    self._wheel_next_arrival(state), ovf_next
+            with self._scope("jump"):
+                q = self.protocol.TIME_QUANTUM
+                ovf_next = jnp.min(
+                    jnp.where(state.ovf_valid, state.ovf_arrival, INT_MAX)
                 )
-            t = jnp.clip(next_arrival, state.time, end).astype(jnp.int32)
-            if q > 1:
-                t = jnp.minimum(
-                    (t + q - 1) // q * q, jnp.asarray(end, jnp.int32)
-                ).astype(jnp.int32)
-            if self.telemetry is not None:
-                tele = state.tele
-                state = state._replace(
-                    tele=tele._replace(
-                        jumps=tele.jumps
-                        + (t > state.time).astype(jnp.int32),
-                        jumped_ms=tele.jumped_ms + (t - state.time),
+                if self.flat:
+                    next_arrival = ovf_next
+                else:
+                    next_arrival = jnp.minimum(
+                        self._wheel_next_arrival(state), ovf_next
                     )
-                )
-            state = state._replace(time=t)
+                t = jnp.clip(next_arrival, state.time, end).astype(jnp.int32)
+                if q > 1:
+                    t = jnp.minimum(
+                        (t + q - 1) // q * q, jnp.asarray(end, jnp.int32)
+                    ).astype(jnp.int32)
+                if self.telemetry is not None:
+                    with self._scope("telemetry"):
+                        tele = state.tele
+                        state = state._replace(
+                            tele=tele._replace(
+                                jumps=tele.jumps
+                                + (t > state.time).astype(jnp.int32),
+                                jumped_ms=tele.jumped_ms + (t - state.time),
+                            )
+                        )
+                state = state._replace(time=t)
         return state
 
     # -- the loop ------------------------------------------------------------
@@ -1007,8 +1065,17 @@ class BatchedNetwork:
             )(states)
 
         step_v = jax.vmap(self._step_core)
-        beat_v = jax.vmap(lambda s: proto.tick_beat(self, s))
-        post_v = jax.vmap(lambda s: proto.tick_post(self, s))
+
+        def _beat(s):
+            with self._scope("beat"):
+                return proto.tick_beat(self, s)
+
+        def _post(s):
+            with self._scope("post"):
+                return proto.tick_post(self, s)
+
+        beat_v = jax.vmap(_beat)
+        post_v = jax.vmap(_post)
         res = jnp.asarray(sorted(residues), jnp.int32)
 
         def skip_beat(s):

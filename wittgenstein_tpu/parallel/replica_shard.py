@@ -7,16 +7,28 @@ sharded R/D per device; the statistics reduction (min/max/mean over the
 (replica, node) axes) compiles to on-device partial reductions plus the
 cross-device collective XLA chooses for the sharding — no host gather of
 per-replica state ever happens.
+
+Cost accounting (ISSUE-7): every program this cache compiles goes
+through the explicit AOT path (lower → compile → call), so the compiled
+object is in hand to capture `cost_analysis()` / `memory_analysis()`
+and the compile wall-clock.  The cache therefore knows, per (protocol,
+config, horizon, input geometry): FLOPs, bytes accessed, live/temp HBM,
+and compile seconds — run_cache_metrics() exports all of it, and the
+hit/miss/eviction/compile-seconds counters feed the server's
+witt_run_cache_* Prometheus families.
 """
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..profiling.xla_cost import compiled_cost_summary
 
 
 def shard_replicas(states, mesh: Mesh, axis: str = "replicas"):
@@ -32,48 +44,136 @@ def shard_replicas(states, mesh: Mesh, axis: str = "replicas"):
 # with a clear hook: long sweep campaigns that churn through many configs
 # can flush it (clear_run_cache) rather than pinning 64 full jit programs
 # (and the engines/latency tables their closures hold) for process life.
-_RUN_CACHE: "OrderedDict[tuple, object]" = OrderedDict()
+_RUN_CACHE: "OrderedDict[tuple, _CachedRun]" = OrderedDict()
 _RUN_CACHE_MAX = 64
+
+# monotonic across clear_run_cache() — Prometheus counters must never
+# step backwards just because a campaign flushed the program cache
+_COUNTERS = {
+    "hits": 0,
+    "misses": 0,
+    "evictions": 0,
+    "compiles": 0,
+    "compile_seconds_total": 0.0,
+}
+
+
+class _CachedRun:
+    """The cached entry for one (net.cache_key(), sim_ms): a callable
+    with jit semantics whose compiles are explicit.  Per input geometry
+    (leaf shapes/dtypes/shardings) it lowers and compiles ONCE, records
+    the compile wall-clock and the normalized cost/memory analyses, then
+    dispatches to the compiled executable."""
+
+    def __init__(self, net, sim_ms: int, key: tuple):
+        self.key = key
+        self.protocol = type(net.protocol).__name__
+        self.sim_ms = int(sim_ms)
+
+        @jax.jit
+        def fn(s):
+            out = net.run_ms_batched(s, sim_ms)
+            live = ~out.down
+            done = jnp.where(live, out.done_at, 0)
+            n_live = jnp.maximum(1, jnp.sum(live.astype(jnp.int32)))
+            stats = {
+                "done_min": jnp.min(
+                    jnp.where(live, out.done_at, jnp.int32(2**31 - 1))
+                ),
+                "done_max": jnp.max(done),
+                "done_avg": jnp.sum(done) / n_live,
+                "msg_rcv_avg": jnp.sum(jnp.where(live, out.msg_received, 0))
+                / n_live,
+                "all_done": jnp.all(jnp.where(live, out.done_at > 0, True)),
+            }
+            return out, stats
+
+        self._jit = fn
+        self._programs: "OrderedDict[tuple, object]" = OrderedDict()
+        self._summaries: "OrderedDict[tuple, dict]" = OrderedDict()
+
+    @staticmethod
+    def _signature(states) -> tuple:
+        sig = []
+        for leaf in jax.tree_util.tree_leaves(states):
+            sharding = getattr(leaf, "sharding", None)
+            try:
+                hash(sharding)
+            except TypeError:  # unhashable placement — fall back to repr
+                sharding = repr(sharding)
+            sig.append(
+                (tuple(leaf.shape), str(getattr(leaf, "dtype", "?")), sharding)
+            )
+        return tuple(sig)
+
+    def __call__(self, states):
+        sig = self._signature(states)
+        compiled = self._programs.get(sig)
+        if compiled is None:
+            t0 = time.perf_counter()
+            compiled = self._jit.lower(states).compile()
+            dt = time.perf_counter() - t0
+            _COUNTERS["compiles"] += 1
+            _COUNTERS["compile_seconds_total"] += dt
+            self._programs[sig] = compiled
+            self._summaries[sig] = {
+                "replicas": next(
+                    (s[0][0] for s in sig if s[0]), None
+                ),
+                **compiled_cost_summary(compiled, dt),
+            }
+        return compiled(states)
+
+    def summaries(self) -> list:
+        return list(self._summaries.values())
 
 
 def clear_run_cache() -> None:
     """Drop every cached compiled run program (the lru_cache.cache_clear
-    analog for long campaigns)."""
+    analog for long campaigns).  The cost counters survive — they are
+    Prometheus counters, monotonic by contract."""
     _RUN_CACHE.clear()
 
 
 def run_cache_info() -> dict:
-    return {"size": len(_RUN_CACHE), "maxsize": _RUN_CACHE_MAX}
+    return {"size": len(_RUN_CACHE), "maxsize": _RUN_CACHE_MAX, **_COUNTERS}
+
+
+def run_cache_metrics() -> dict:
+    """The export view (server /metrics + run records): counters plus
+    per-entry compiled-program cost/memory summaries."""
+    return {
+        **_COUNTERS,
+        "size": len(_RUN_CACHE),
+        "maxsize": _RUN_CACHE_MAX,
+        "entries": [
+            {
+                "protocol": entry.protocol,
+                "sim_ms": entry.sim_ms,
+                "programs": entry.summaries(),
+            }
+            for entry in _RUN_CACHE.values()
+        ],
+    }
 
 
 def _run_and_reduce(net, sim_ms: int):
-    """One compiled program per (net.cache_key(), sim_ms): repeated calls
+    """One cached entry per (net.cache_key(), sim_ms): repeated calls
     with an equivalent network hit the cache instead of re-tracing the
     full simulation."""
     key = (net.cache_key(), int(sim_ms))
     fn = _RUN_CACHE.get(key)
     if fn is not None:
+        _COUNTERS["hits"] += 1
         _RUN_CACHE.move_to_end(key)
         return fn
 
-    @jax.jit
-    def fn(s):
-        out = net.run_ms_batched(s, sim_ms)
-        live = ~out.down
-        done = jnp.where(live, out.done_at, 0)
-        n_live = jnp.maximum(1, jnp.sum(live.astype(jnp.int32)))
-        stats = {
-            "done_min": jnp.min(jnp.where(live, out.done_at, jnp.int32(2**31 - 1))),
-            "done_max": jnp.max(done),
-            "done_avg": jnp.sum(done) / n_live,
-            "msg_rcv_avg": jnp.sum(jnp.where(live, out.msg_received, 0)) / n_live,
-            "all_done": jnp.all(jnp.where(live, out.done_at > 0, True)),
-        }
-        return out, stats
-
+    _COUNTERS["misses"] += 1
+    fn = _CachedRun(net, sim_ms, key)
     _RUN_CACHE[key] = fn
     while len(_RUN_CACHE) > _RUN_CACHE_MAX:
         _RUN_CACHE.popitem(last=False)
+        _COUNTERS["evictions"] += 1
     return fn
 
 

@@ -1,0 +1,105 @@
+"""Pytree-leaf HBM footprint model: replicas per chip from the actual
+SimState leaves.
+
+The replica-density claim behind the D=32 channel depth ("~106 MiB per
+4096-node replica, still 32+ replicas inside a v5e chip's HBM" —
+protocols/handel_batched.py) was hand-arithmetic until now.  This model
+walks the real init_state() pytree, so any state-layout change (a new
+side-car, a wider channel) moves the number automatically.
+
+Model, not measurement: run_ms_batched's true peak adds XLA temp buffers
+on top of the live state (double-buffered scan carries, fusion
+scratch).  xla_cost.memory_analysis_dict() reports the measured
+temp_size for one compiled geometry; replicas_per_chip() takes an
+`overhead` factor calibrated from it (default 2.0x — one extra live
+copy, the scan carry's worst case with donation off, the
+runtime/supervisor default).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# v5e: 16 GiB HBM per chip (the ROADMAP's deployment target)
+DEFAULT_HBM_GIB = 16.0
+DEFAULT_STATE_OVERHEAD = 2.0
+
+
+def state_bytes_per_replica(state) -> dict:
+    """Total bytes of one replica's SimState pytree and the top
+    contributors: {"total_bytes", "n_leaves", "top": [(path, bytes)]}.
+
+    `state` must be UNREPLICATED (no leading replica axis) — pass the
+    init_state() result, not replicate_state()'s."""
+    import jax
+
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(state)[0]
+    sizes = []
+    total = 0
+    for path, leaf in leaves_with_paths:
+        nb = int(getattr(leaf, "size", 0)) * int(
+            getattr(getattr(leaf, "dtype", None), "itemsize", 0) or 0
+        )
+        total += nb
+        sizes.append((jax.tree_util.keystr(path), nb))
+    sizes.sort(key=lambda kv: -kv[1])
+    return {
+        "total_bytes": total,
+        "n_leaves": len(sizes),
+        "top": sizes[:8],
+    }
+
+
+def replicas_per_chip(
+    state,
+    hbm_gib: float = DEFAULT_HBM_GIB,
+    overhead: float = DEFAULT_STATE_OVERHEAD,
+    reserved_gib: float = 0.5,
+) -> dict:
+    """HBM-bounded replica count for one chip: floor((HBM - reserved) /
+    (bytes_per_replica * overhead)).  `reserved_gib` covers compiled
+    code + runtime framebuffers."""
+    per = state_bytes_per_replica(state)
+    usable = max(0.0, (hbm_gib - reserved_gib)) * (1 << 30)
+    denom = per["total_bytes"] * max(1.0, overhead)
+    return {
+        "bytes_per_replica": per["total_bytes"],
+        "mib_per_replica": round(per["total_bytes"] / (1 << 20), 1),
+        "hbm_gib": hbm_gib,
+        "reserved_gib": reserved_gib,
+        "overhead_factor": overhead,
+        "replicas": int(usable // denom) if denom else 0,
+    }
+
+
+def hbm_report(
+    state,
+    memory: Optional[dict] = None,
+    hbm_gib: float = DEFAULT_HBM_GIB,
+) -> dict:
+    """The BUDGET.json "hbm" block: leaf model + (when a compiled
+    program's memory_analysis is available) the measured-vs-modeled
+    cross-check.  `memory` is xla_cost.memory_analysis_dict() output for
+    a run_ms program on ONE replica of this state."""
+    density = replicas_per_chip(state, hbm_gib=hbm_gib)
+    out = {
+        "model": density,
+        "top_leaves": [
+            {"path": p, "bytes": b}
+            for p, b in state_bytes_per_replica(state)["top"]
+        ],
+    }
+    if memory:
+        # measured live bytes for 1 replica vs the modeled
+        # bytes_per_replica * overhead — how honest is the 2x factor?
+        live = memory.get("live_bytes", 0)
+        modeled = density["bytes_per_replica"] * density["overhead_factor"]
+        out["measured"] = {
+            "live_bytes_1_replica": live,
+            "temp_bytes": memory.get("temp_size_in_bytes", 0),
+            "modeled_bytes": int(modeled),
+            "model_over_measured": (
+                round(modeled / live, 2) if live else None
+            ),
+        }
+    return out

@@ -1,0 +1,128 @@
+"""Normalized XLA cost/memory accounting for compiled entry points.
+
+jax 0.4.x API quirks this module absorbs so callers never touch them:
+
+  * `compiled.cost_analysis()` returns a LIST of per-computation dicts
+    (usually length 1) whose keys mix scalars ("flops", "bytes
+    accessed", "transcendentals") with per-operand entries ("bytes
+    accessed0{}", "bytes accessedout{}", ...);
+  * `compiled.memory_analysis()` returns an opaque CompiledMemoryStats
+    object (attrs, not a mapping), and either call may return None or
+    raise on backends that don't implement it (the CPU backend DOES
+    implement both as of jaxlib 0.4.37 — docs/profiling.md records the
+    per-backend caveats).
+
+Everything returned here is plain JSON-able floats/ints, ready for
+BENCH records, BUDGET.json, and run_cache_metrics().
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+_MEMORY_ATTRS = (
+    "generated_code_size_in_bytes",
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "alias_size_in_bytes",
+    "temp_size_in_bytes",
+    "host_generated_code_size_in_bytes",
+    "host_argument_size_in_bytes",
+    "host_output_size_in_bytes",
+    "host_alias_size_in_bytes",
+    "host_temp_size_in_bytes",
+)
+
+
+def cost_analysis_dict(compiled) -> Optional[dict]:
+    """Scalar totals from compiled.cost_analysis(): {"flops",
+    "bytes_accessed", "transcendentals", "optimal_seconds"} summed over
+    the returned computations, per-operand breakdown entries dropped.
+    None when the backend can't say."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return None
+    if cost is None:
+        return None
+    if isinstance(cost, dict):  # jax >= 0.5 flattens the list
+        cost = [cost]
+    wanted = {
+        "flops": "flops",
+        "bytes accessed": "bytes_accessed",
+        "transcendentals": "transcendentals",
+        "optimal_seconds": "optimal_seconds",
+    }
+    out: dict = {}
+    for comp in cost:
+        for src, dst in wanted.items():
+            if src in comp:
+                out[dst] = out.get(dst, 0.0) + float(comp[src])
+    return out or None
+
+
+def memory_analysis_dict(compiled) -> Optional[dict]:
+    """CompiledMemoryStats as a plain dict (suffix _in_bytes kept), plus
+    "live_bytes" = argument + output + temp — the footprint that must
+    fit in device memory for one invocation (code size excluded: HBM vs
+    host split varies by backend; aliased/donated bytes excluded since
+    they overlap arguments)."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+    out: dict = {}
+    for attr in _MEMORY_ATTRS:
+        v = getattr(mem, attr, None)
+        if v is not None:
+            out[attr] = int(v)
+    if not out:
+        return None
+    out["live_bytes"] = (
+        out.get("argument_size_in_bytes", 0)
+        + out.get("output_size_in_bytes", 0)
+        + out.get("temp_size_in_bytes", 0)
+    )
+    return out
+
+
+def compiled_cost_summary(compiled, compile_seconds: Optional[float] = None) -> dict:
+    """The record the run cache stores per compiled program: cost +
+    memory normalized, compile wall-clock if the caller timed it."""
+    out: dict = {
+        "cost": cost_analysis_dict(compiled),
+        "memory": memory_analysis_dict(compiled),
+    }
+    if compile_seconds is not None:
+        out["compile_seconds"] = round(float(compile_seconds), 3)
+    return out
+
+
+def lower_and_summarize(fn, *args, static_argnums=(), **kw) -> dict:
+    """Convenience: jit+lower+compile `fn` on example args and return
+    its compiled_cost_summary (with measured compile seconds).  Used by
+    scripts/budget_report.py to price run_ms without running it."""
+    import time
+
+    import jax
+
+    t0 = time.perf_counter()
+    compiled = (
+        jax.jit(fn, static_argnums=static_argnums).lower(*args, **kw).compile()
+    )
+    return compiled_cost_summary(compiled, time.perf_counter() - t0)
+
+
+def format_bytes(n: Any) -> str:
+    """Human side-channel for reports: 111_149_056 -> '106.0 MiB'."""
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TiB"

@@ -120,6 +120,12 @@ class BatchedHandel(BitsetAggBase):
     # tick); the price is channel memory, ~3.7x on in_sig — ~106 MiB per
     # 4096-node replica, still 32+ replicas inside a v5e chip's HBM.
     CHANNEL_DEPTH = 32
+    # r5 parity fix: _select reads the END-of-previous-tick candidate and
+    # merge state (see tick() below).  Instance-overridable so the
+    # profiling ablation (profiling/ablation.py) can price the snapshot
+    # dicts the view costs per tick; False reproduces the pre-r5
+    # one-tick-lead selection and is NOT parity-correct.
+    BOUNDARY_VIEW = True
 
     def __init__(self, params: HandelParameters):
         self.params = params
@@ -872,6 +878,10 @@ class BatchedHandel(BitsetAggBase):
         # measured as a -4..-9 ms CDF lead (docs/TPU_NOTES.md r5).  The
         # busy gate stays post-commit (a commit at t frees the node for a
         # same-tick re-select, like the reference's minStartTime spacing).
+        if not self.BOUNDARY_VIEW:  # pre-r5 ablation lever: same-tick view
+            state = self._channel_deliver(net, state)
+            state = self._commit(net, state)
+            return self._select(net, state)
         pre_cand = {k: state.proto[k] for k in self._cand_keys()}
         state = self._channel_deliver(net, state)
         pre_merge = {
@@ -897,6 +907,8 @@ def make_handel(
     seed: int = 0,
     wheel_rows: int = 0,  # flat by default; >0 = time wheel (parity tests)
     telemetry=None,  # telemetry.TelemetryConfig (None = uninstrumented)
+    boundary_view: bool = True,  # False = pre-r5 selection (ablation only)
+    annotate: bool = True,  # False = strip named-scope phase markers
 ):
     """Host-side construction: build the node population with the oracle's
     RNG stream (positions, speed ratios, down set), bake into the engine."""
@@ -927,6 +939,7 @@ def make_handel(
     ).astype(np.int32)
 
     proto = BatchedHandel(params)
+    proto.BOUNDARY_VIEW = bool(boundary_view)
     # beat structure for the engine's real-branch gating: dissemination
     # fires at t with (t - (start_at + 1)) % period == 0
     proto.BEAT_PERIOD = params.dissemination_period_ms
@@ -954,7 +967,7 @@ def make_handel(
     # scan minimal
     net = BatchedNetwork(
         proto, latency, n, capacity=capacity, wheel_rows=wheel_rows,
-        telemetry=telemetry,
+        telemetry=telemetry, annotate=annotate,
     )
     state = net.init_state(
         cols,

@@ -20,6 +20,7 @@ from .policy import DegradePolicy, RetryPolicy, WatchdogPolicy
 from .supervisor import (
     RunReport,
     Supervisor,
+    chunk_time_histogram,
     run_with_deadline,
     stable_run_key,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "TransientRunError",
     "WatchdogPolicy",
     "WatchdogTimeoutError",
+    "chunk_time_histogram",
     "classify",
     "run_with_deadline",
     "stable_run_key",

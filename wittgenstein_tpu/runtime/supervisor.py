@@ -93,6 +93,28 @@ def run_with_deadline(fn: Callable[[], Any], deadline_s: float, phase: str):
     return box["out"]
 
 
+# per-chunk wall-time histogram buckets (seconds): the interesting
+# decades between "CPU smoke chunk" and "tunnel watchdog kill"
+CHUNK_HIST_BUCKETS_S = (0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+def chunk_time_histogram(times: List[float]) -> dict:
+    """Prometheus-style cumulative histogram of chunk wall-times:
+    {"buckets": {"0.1": n, ..., "+Inf": n}, "count", "sum_s", "max_s"}.
+    Shared by Supervisor provenance and the server/bench exports so one
+    bucket layout exists."""
+    buckets = {}
+    for le in CHUNK_HIST_BUCKETS_S:
+        buckets[str(le)] = sum(1 for t in times if t <= le)
+    buckets["+Inf"] = len(times)
+    return {
+        "buckets": buckets,
+        "count": len(times),
+        "sum_s": round(sum(times), 4),
+        "max_s": round(max(times), 4) if times else 0.0,
+    }
+
+
 def stable_run_key(net: Any, template: Any, n_chunks: int, chunk_ms: int) -> str:
     """A run identity that survives process restarts (unlike
     core.cache_key, which hashes object ids): protocol type + chunk
@@ -155,6 +177,7 @@ class Supervisor:
         max_chunks_this_run: Optional[int] = None,
         sleep: Callable[[float], None] = time.sleep,
         consume_template: bool = False,
+        tracer: Any = None,
     ):
         if n_chunks < 1:
             raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
@@ -183,6 +206,9 @@ class Supervisor:
         self.max_chunks_this_run = max_chunks_this_run
         self.sleep = sleep
         self.consume_template = consume_template
+        # optional telemetry.trace.SpanTracer: chunk spans + instants
+        # for retry/degrade/watchdog events land in the Chrome trace
+        self.tracer = tracer
         self._first_call_done = False
         self._degraded = False
 
@@ -315,6 +341,7 @@ class Supervisor:
         i = start_chunk
         fail_streak = 0
         retries_total = 0
+        watchdog_timeouts = 0
         checkpoints = 0
         degraded_at = None
         t_start = time.perf_counter()
@@ -328,11 +355,13 @@ class Supervisor:
                 "degraded_at_chunk": degraded_at,
                 "resumed_from_step": resumed_from,
                 "retries": retries_total,
+                "watchdog_timeouts": watchdog_timeouts,
                 "checkpoints": checkpoints,
                 "run_key": self.run_key,
                 "chunk_ms": self.chunk_ms,
                 "n_chunks": self.n_chunks,
                 "chunks_done": done,
+                "chunk_time_hist": chunk_time_histogram(times),
             }
 
         while i < self.n_chunks:
@@ -354,8 +383,20 @@ class Supervisor:
                 t1 = time.perf_counter()
                 state = self._run_chunk(state)
                 dt = time.perf_counter() - t1
+                if self.tracer is not None:
+                    self.tracer.add_span(
+                        "chunk", self.tracer.now_us() - dt * 1e6, dt * 1e6,
+                        chunk=i, degraded=self._degraded,
+                    )
             except BaseException as e:  # noqa: BLE001 — classified below
                 kind = classify(e)
+                if isinstance(e, WatchdogTimeoutError):
+                    watchdog_timeouts += 1
+                if self.tracer is not None:
+                    self.tracer.instant(
+                        "chunk-failed", chunk=i, kind=kind,
+                        error=type(e).__name__,
+                    )
                 if kind == "fatal":
                     raise
                 fail_streak += 1
@@ -371,6 +412,8 @@ class Supervisor:
                     self._degraded = True
                     degraded_at = i
                     self._first_call_done = False  # CPU gets a compile
+                    if self.tracer is not None:
+                        self.tracer.instant("degraded-to-cpu", chunk=i)
                 self.sleep(self.retry.delay_s(fail_streak - 1))
                 # replay deterministically from the last anchor: the
                 # chunks between anchor_chunk and i re-run and produce

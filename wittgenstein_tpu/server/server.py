@@ -168,6 +168,7 @@ class Server:
 
         p = PromText("witt")
         p.add("server_up", 1, "wittgenstein-tpu control server alive")
+        self._add_cost_metrics(p)
         if self._protocol is None:
             return p.render()
         s = self.get_status()
@@ -195,6 +196,40 @@ class Server:
         p.add("store_pending_buckets", occ["pending_buckets"], "occupied ms buckets")
         p.add("conditional_tasks", occ["conditional_tasks"], "registered conditional tasks")
         return p.render()
+
+    @staticmethod
+    def _add_cost_metrics(p) -> None:
+        """witt_run_cache_* (compiled-program cache counters + compile
+        seconds, from parallel.replica_shard) and witt_probe_* (TTL'd
+        TPU probe verdict, from profiling.probe) — the ISSUE-7 cost/
+        visibility families.  Failures never break /metrics: these are
+        best-effort observability, rendered as absent when the process
+        has no jax / no probe cache."""
+        try:
+            from ..parallel.replica_shard import run_cache_info
+
+            info = run_cache_info()
+            p.add("run_cache_size", info["size"],
+                  "cached compiled run programs", "gauge")
+            p.add("run_cache_hits_total", info["hits"],
+                  "run-cache lookups served from cache", "counter")
+            p.add("run_cache_misses_total", info["misses"],
+                  "run-cache lookups that built a new entry", "counter")
+            p.add("run_cache_evictions_total", info["evictions"],
+                  "run-cache entries dropped by the FIFO bound", "counter")
+            p.add("run_cache_compiles_total", info["compiles"],
+                  "XLA compiles performed by the run cache", "counter")
+            p.add("run_cache_compile_seconds_total",
+                  round(info["compile_seconds_total"], 3),
+                  "wall-clock spent in run-cache XLA compiles", "counter")
+        except Exception:
+            pass
+        try:
+            from ..profiling.probe import add_probe_metrics
+
+            add_probe_metrics(p)
+        except Exception:
+            pass
 
     # -- control -------------------------------------------------------------
     def start_node(self, node_id: int) -> None:

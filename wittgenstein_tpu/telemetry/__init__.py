@@ -41,7 +41,7 @@ from .export import (
     prometheus_from_counters,
     read_run_records,
 )
-from .phases import engine_phase_fns, scan_phase_seconds
+from .phases import engine_phase_fns, phase_means, scan_phase_seconds
 from .state import TelemetryConfig, TelemetryState, init_telemetry
 from .trace import SpanTracer, maybe_span, validate_chrome_trace
 
@@ -57,6 +57,7 @@ __all__ = [
     "init_telemetry",
     "maybe_span",
     "pending_count",
+    "phase_means",
     "progress_series",
     "prometheus_from_counters",
     "read_run_records",

@@ -3,16 +3,22 @@ points (bench.py phase_profile() and scripts/phase_profile.py), spans
 recorded on the telemetry tracer.
 
 The measurement pattern both callers used to duplicate: jit a
-`lax.scan` of `vmap(phase_fn)` over the stacked states, run once to
-compile + warm, then time a second run and divide by the scan length.
-Phases overlap by construction (delivery is part of the full step), so
-the numbers are an op-cost RANKING, not a partition — both callers
-document this; keeping the loop here keeps the caveat true in one
-place.
+`lax.scan` of `vmap(phase_fn)` over the stacked states, then time
+repeated passes and divide by the scan length.  Phases overlap by
+construction (delivery is part of the full step), so the numbers are an
+op-cost RANKING, not a partition — both callers document this; keeping
+the loop here keeps the caveat true in one place.
+
+Warmup discipline (ISSUE-7 satellite): the first post-compile call pays
+residual dispatch/executable-load cost that is NOT per-tick work, so
+one full pass is run and DISCARDED between compile and measurement, and
+the timed passes repeat so each phase reports mean + stddev — an
+ablation delta is only trustworthy when it exceeds the measured spread.
 """
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Callable, Dict, Optional
 
@@ -24,27 +30,55 @@ def scan_phase_seconds(
     phases: Dict[str, Callable],
     scans: int = 25,
     tracer: Optional[SpanTracer] = None,
-) -> Dict[str, float]:
-    """Seconds per iteration for each named phase fn (state -> state),
+    repeats: int = 3,
+) -> Dict[str, dict]:
+    """Per-iteration timing for each named phase fn (state -> state),
     vmapped over the leading replica axis of `states` and scanned
-    `scans` times inside one jit.  Compile+warm and the timed run are
-    recorded as spans when a tracer is given."""
+    `scans` times inside one jit.
+
+    Per phase: one compile pass, one discarded warmup pass (residual
+    dispatch — the pre-r11 loop folded it into the measurement), then
+    `repeats` timed passes.  Returns
+    {name: {mean_s, std_s, min_s, samples_s, scans, repeats}} where the
+    *_s values are seconds per scan iteration.  Every pass is recorded
+    as a span when a tracer is given."""
     import jax
     from jax import lax
 
-    out: Dict[str, float] = {}
+    out: Dict[str, dict] = {}
+    repeats = max(1, int(repeats))
     for name, fn in phases.items():
         def body(s, _, fn=fn):
             return jax.vmap(fn)(s), None
 
         stepped = jax.jit(lambda s, body=body: lax.scan(body, s, None, length=scans)[0])
-        with maybe_span(tracer, "compile+warm", phase=name, scans=scans):
+        with maybe_span(tracer, "compile", phase=name, scans=scans):
             jax.block_until_ready(stepped(states))
-        with maybe_span(tracer, "measure", phase=name, scans=scans):
-            t0 = time.perf_counter()
+        with maybe_span(tracer, "warmup-discarded", phase=name, scans=scans):
             jax.block_until_ready(stepped(states))
-            out[name] = (time.perf_counter() - t0) / scans
+        samples = []
+        for r in range(repeats):
+            with maybe_span(tracer, "measure", phase=name, scans=scans, repeat=r):
+                t0 = time.perf_counter()
+                jax.block_until_ready(stepped(states))
+                samples.append((time.perf_counter() - t0) / scans)
+        mean = sum(samples) / len(samples)
+        var = sum((x - mean) ** 2 for x in samples) / len(samples)
+        out[name] = {
+            "mean_s": mean,
+            "std_s": math.sqrt(var),
+            "min_s": min(samples),
+            "samples_s": samples,
+            "scans": scans,
+            "repeats": repeats,
+        }
     return out
+
+
+def phase_means(stats: Dict[str, dict]) -> Dict[str, float]:
+    """Collapse a scan_phase_seconds() result to {name: mean seconds} —
+    for callers that only rank phases."""
+    return {k: v["mean_s"] for k, v in stats.items()}
 
 
 def engine_phase_fns(net) -> Dict[str, Callable]:

@@ -50,6 +50,11 @@ class SpanTracer:
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
+    def now_us(self) -> float:
+        """The tracer's clock (µs since construction) — for callers that
+        time work themselves and report via add_span."""
+        return self._now_us()
+
     def _tid(self) -> int:
         ident = threading.get_ident()
         with self._lock:
