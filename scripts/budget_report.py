@@ -62,7 +62,15 @@ def measure(node_ct: int) -> dict:
     from wittgenstein_tpu.protocols.handel_batched import make_handel
     from wittgenstein_tpu.telemetry import TelemetryConfig, counters
 
-    net, state = make_handel(flagship_params(node_ct))
+    # The budget is the TPU feasibility statement, so it prices the TPU
+    # production config even though it always runs on CPU: fuse_step=True
+    # (bench_batched's config) and score_cache PINNED ON (the backend-auto
+    # default would drop the cache leaves on this CPU run and understate
+    # the TPU state the replicas/chip model must hold).  Tick counts are
+    # bit-identical across both levers, so ticks_per_sim is unaffected.
+    net, state = make_handel(
+        flagship_params(node_ct), score_cache=True, fuse_step=True
+    )
 
     # (2) the compiled bare program: compile cost + XLA cost/memory.
     # stop_when_done=True is the bench path — the budget prices the
@@ -118,13 +126,41 @@ def measure(node_ct: int) -> dict:
 
 
 def check() -> int:
-    """CI gate: BUDGET.json must exist, parse, and not be stale vs
-    BENCH_FLOOR.json."""
-    from wittgenstein_tpu.profiling import budget_staleness, load_budget
+    """CI gate: BUDGET.json must exist, parse, not be stale vs
+    BENCH_FLOOR.json, and its required_tick_us must still equal the
+    arithmetic freshly derived from its own recorded inputs (a
+    hand-edited or half-regenerated artifact fails loudly)."""
+    from wittgenstein_tpu.profiling import (
+        budget_staleness,
+        load_budget,
+        required_tick_us,
+    )
 
     budget = load_budget(root=ROOT)
     if budget is None:
         print("BUDGET.json missing or unreadable at repo root", file=sys.stderr)
+        return 1
+    try:
+        fresh = required_tick_us(
+            int(budget["replicas_per_chip"]),
+            float(budget["ticks_per_sim"]),
+            float(budget["north_star_sims_per_sec_per_chip"]),
+        )
+    except (KeyError, TypeError, ValueError) as e:
+        print(f"BUDGET.json inputs unusable for re-derivation: {e}",
+              file=sys.stderr)
+        return 1
+    recorded = float(budget.get("required_tick_us", 0.0))
+    if abs(fresh - recorded) > 0.01:
+        print(
+            f"BUDGET.json required_tick_us DRIFTED: recorded {recorded}"
+            f" but R/(sims_per_sec*ticks_per_sim)*1e6 ="
+            f" {round(fresh, 2)} from its own inputs"
+            f" (R={budget['replicas_per_chip']},"
+            f" ticks={budget['ticks_per_sim']}) — regenerate"
+            " scripts/budget_report.py",
+            file=sys.stderr,
+        )
         return 1
     floor_path = os.path.join(ROOT, "BENCH_FLOOR.json")
     if not os.path.exists(floor_path):

@@ -135,7 +135,9 @@ def campaign() -> None:
         log({"event": "abort", "reason": f"platform {dev.platform} != tpu"})
         return
 
-    net, state0 = make_handel(benchmod._params(NODES))
+    # same production config as bench.bench_batched: fused delivery+tick,
+    # score cache at its backend-auto default (ON here on TPU)
+    net, state0 = make_handel(benchmod._params(NODES), fuse_step=True)
     skip = done_rungs()
 
     results = []

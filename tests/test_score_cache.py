@@ -1,0 +1,270 @@
+"""Candidate-score caching bit-identity (PR-8 lever 1).
+
+Handel carries four cached candidate-slot quantities in `state.proto`
+(`cand_s`/`cand_card`/`cand_wind`/`cand_aggi`) so the per-tick `_select`
+reads int32 scores instead of re-popcounting signature words; P2PHandel
+carries `ver_card`.  Caching is a COST lever only: with it off
+(`score_cache=False`) every non-cache leaf of the trajectory must be
+bitwise unchanged, and with it on, the carried leaves must always equal
+`recompute_caches()`'s from-scratch oracle (the SL701 invariant).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.protocols.handel import HandelParameters
+from wittgenstein_tpu.protocols.handel_batched import (
+    BatchedHandel,
+    make_handel,
+)
+from wittgenstein_tpu.protocols.p2phandel import P2PHandelParameters
+from wittgenstein_tpu.protocols.p2phandel_batched import make_p2phandel
+
+CACHE_LEAVES = set(BatchedHandel.CACHE_LEAF_NAMES)
+
+
+def _two_replicas(state):
+    states = jax.tree_util.tree_map(lambda x: jnp.stack([x, x]), state)
+    return states._replace(seed=states.seed.at[1].set(99))
+
+
+def _assert_equal_excluding_cache(on, off, cache_leaves, tag):
+    for f in on._fields:
+        a, b = getattr(on, f), getattr(off, f)
+        if f == "proto":
+            for k in b:  # the cached run has extra (cache) leaves
+                assert k not in cache_leaves or k in b
+                assert bool(jnp.array_equal(a[k], b[k])), (
+                    f"{tag}: proto[{k}] diverges with caching on"
+                )
+        else:
+            eq = jax.tree_util.tree_map(
+                lambda x, y: bool(jnp.array_equal(x, y)), a, b
+            )
+            assert all(jax.tree_util.tree_leaves(eq)), (
+                f"{tag}: field {f} diverges with caching on"
+            )
+
+
+def _assert_cache_consistent(net, out, tag):
+    # out is replica-batched; recompute_caches is a per-replica kernel
+    fresh = jax.vmap(net.protocol.recompute_caches)(out)
+    assert fresh, f"{tag}: recompute_caches returned nothing"
+    for k, v in fresh.items():
+        assert bool(jnp.array_equal(out.proto[k], v)), (
+            f"{tag}: carried cache '{k}' differs from from-scratch"
+            " recompute (stale cache)"
+        )
+
+
+@pytest.mark.parametrize(
+    "boundary_view,wheel_rows",
+    [(True, 0), (True, 64), (False, 0)],
+    ids=["bv-flat", "bv-wheel64", "nobv-flat"],
+)
+def test_handel_cache_bit_identity(boundary_view, wheel_rows):
+    params = HandelParameters(node_count=64)
+
+    def run(score_cache):
+        net, state = make_handel(
+            params,
+            seed=3,
+            wheel_rows=wheel_rows,
+            boundary_view=boundary_view,
+            score_cache=score_cache,
+        )
+        return net, net.run_ms_batched(_two_replicas(state), 150)
+
+    net_on, on = run(True)
+    _net_off, off = run(False)
+    tag = f"handel bv={boundary_view} wheel={wheel_rows}"
+    assert CACHE_LEAVES <= set(on.proto), tag
+    assert not (CACHE_LEAVES & set(off.proto)), tag
+    _assert_equal_excluding_cache(on, off, CACHE_LEAVES, tag)
+    _assert_cache_consistent(net_on, on, tag)
+
+
+def test_handel_cache_survives_commits():
+    """A long-enough run that levels actually complete: the _commit
+    cache fix-up (recompute only the committed level) is the subtle
+    invalidation path, so exercise it for real."""
+    net, state = make_handel(
+        HandelParameters(node_count=32), seed=5, score_cache=True
+    )
+    states = _two_replicas(state)
+    out = net.run_ms_batched(states, 400)
+    assert int(jnp.sum(out.done_at > 0)) > 0, (
+        "run too short to exercise commits — bump ms"
+    )
+    _assert_cache_consistent(net, out, "handel 32-node 400ms")
+
+
+@pytest.mark.parametrize("das", [True, False], ids=["checksigs2", "checksigs1"])
+def test_p2phandel_ver_card_bit_identity(das):
+    p = P2PHandelParameters(double_aggregate_strategy=das)
+
+    def run(score_cache):
+        net, state = make_p2phandel(p, seed=3, score_cache=score_cache)
+        return net, net.run_ms_batched(_two_replicas(state), 150)
+
+    net_on, on = run(True)
+    _net_off, off = run(False)
+    tag = f"p2phandel das={das}"
+    assert "ver_card" in on.proto and "ver_card" not in off.proto, tag
+    _assert_equal_excluding_cache(on, off, {"ver_card"}, tag)
+    _assert_cache_consistent(net_on, on, tag)
+
+
+def test_cache_off_removes_declared_leaves():
+    """score_cache=False must also clear DERIVED_CACHE_LEAVES so simlint
+    SL701 skips the config instead of failing on missing leaves."""
+    net, _ = make_handel(HandelParameters(node_count=32), score_cache=False)
+    assert net.protocol.DERIVED_CACHE_LEAVES == ()
+    net, _ = make_p2phandel(P2PHandelParameters(), score_cache=False)
+    assert net.protocol.DERIVED_CACHE_LEAVES == ()
+
+
+def test_cache_default_is_backend_auto():
+    """make_handel(score_cache=None) resolves by backend: the cache is an
+    HBM-bandwidth economy, ON for TPU, OFF elsewhere (the 256x4 CPU
+    ablation prices its maintenance at a 5-10% loss).  Explicit
+    True/False always wins."""
+    import jax
+
+    net, _ = make_handel(HandelParameters(node_count=32))
+    expect = jax.default_backend() == "tpu"
+    assert net.protocol.SCORE_CACHE is expect
+    assert bool(net.protocol.DERIVED_CACHE_LEAVES) is expect
+    net, _ = make_handel(HandelParameters(node_count=32), score_cache=True)
+    assert net.protocol.SCORE_CACHE is True
+    assert net.protocol.DERIVED_CACHE_LEAVES == BatchedHandel.CACHE_LEAF_NAMES
+
+
+# -- SL701: the simlint rule guarding these invariants ----------------------
+
+
+def _mk_entry(factory):
+    from wittgenstein_tpu.core.registries import BatchedProtocolEntry
+
+    return BatchedProtocolEntry("cachefix", "fixture_batched", factory)
+
+
+def _pingpong_with(proto_patch):
+    """pingpong net with a protocol subclass carrying a derived cache."""
+    import copy
+
+    from wittgenstein_tpu.protocols.pingpong_batched import make_pingpong
+
+    def factory():
+        net, state = make_pingpong(32)
+        net = copy.copy(net)
+        net.protocol = proto_patch(32)
+        state = state._replace(
+            proto=dict(state.proto, **net.protocol.recompute_caches(state))
+        )
+        return net, state
+
+    return factory
+
+
+def test_sl701_detects_stale_cache():
+    from wittgenstein_tpu.analysis.contracts import check_entry
+    from wittgenstein_tpu.protocols.pingpong_batched import BatchedPingPong
+
+    class StaleCache(BatchedPingPong):
+        # declares pong_total as derived but never UPDATES it: the leaf
+        # is carried through deliver unchanged, so after pongs arrive
+        # the stale 0 differs from the recompute
+        DERIVED_CACHE_LEAVES = ("pong_total",)
+
+        def recompute_caches(self, state):
+            return {
+                "pong_total": jnp.sum(state.proto["pong"])[None].astype(
+                    jnp.int32
+                )
+            }
+
+        def deliver(self, net, state, deliver_mask):
+            carried = state.proto["pong_total"]
+            state, em = super().deliver(net, state, deliver_mask)
+            return state._replace(
+                proto=dict(state.proto, pong_total=carried)
+            ), em
+
+    findings = check_entry(_mk_entry(_pingpong_with(StaleCache)), root=".")
+    assert any(
+        f.rule == "SL701" and "STALE" in f.message for f in findings
+    ), [f.message for f in findings]
+
+
+def test_sl701_detects_missing_leaf():
+    import copy
+
+    from wittgenstein_tpu.analysis.contracts import check_entry
+    from wittgenstein_tpu.protocols.pingpong_batched import (
+        BatchedPingPong,
+        make_pingpong,
+    )
+
+    class UndeclaredLeaf(BatchedPingPong):
+        DERIVED_CACHE_LEAVES = ("not_in_proto",)
+
+    def factory():
+        net, state = make_pingpong(32)
+        net = copy.copy(net)
+        net.protocol = UndeclaredLeaf(32)
+        return net, state
+
+    findings = check_entry(_mk_entry(factory), root=".")
+    assert any(
+        f.rule == "SL701" and "not present" in f.message for f in findings
+    ), [f.message for f in findings]
+
+
+def test_sl701_clean_on_maintained_cache():
+    from wittgenstein_tpu.analysis.contracts import check_entry
+    from wittgenstein_tpu.protocols.pingpong_batched import BatchedPingPong
+
+    class MaintainedCache(BatchedPingPong):
+        DERIVED_CACHE_LEAVES = ("pong_total",)
+
+        def recompute_caches(self, state):
+            return {
+                "pong_total": jnp.sum(state.proto["pong"])[None].astype(
+                    jnp.int32
+                )
+            }
+
+        def deliver(self, net, state, deliver_mask):
+            state, em = super().deliver(net, state, deliver_mask)
+            proto = dict(state.proto)
+            proto["pong_total"] = jnp.sum(proto["pong"])[None].astype(
+                jnp.int32
+            )
+            return state._replace(proto=proto), em
+
+    findings = check_entry(
+        _mk_entry(_pingpong_with(MaintainedCache)), root="."
+    )
+    assert [f for f in findings if f.rule == "SL701"] == [], [
+        f.message for f in findings
+    ]
+
+
+def test_registered_cache_protocols_pass_sl701():
+    """The real thing: handel and p2phandel registry entries are SL701
+    clean (their carried caches survive 8 concrete engine steps)."""
+    from wittgenstein_tpu.analysis.contracts import _check_derived_cache, _cpu_jax
+    from wittgenstein_tpu.core.registries import registry_batched_protocols
+
+    jx = _cpu_jax()
+    for name in ("handel", "p2phandel"):
+        entry = registry_batched_protocols.get(name)
+        net, state = entry.factory()
+        assert net.protocol.DERIVED_CACHE_LEAVES, name
+        findings = _check_derived_cache(
+            jx, name, net, state, "x", 1, set()
+        )
+        assert findings == [], [f.message for f in findings]

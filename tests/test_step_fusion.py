@@ -1,0 +1,97 @@
+"""Fused delivery+tick vs the unfused phase pipeline (PR-8 lever 2).
+
+`BatchedNetwork(fuse_step=True)` collapses the wheel-gather / clear /
+tick phases into one `witt.fused_step` scope (one combined state
+_replace, and — in the q==1 all-due wheel regime — a static empty-row
+fill instead of the sort/cumsum repack).  Fusion is a COST lever only:
+every registered protocol must produce bit-identical trajectories with
+it on, in both store layouts, with side-cars armed or not.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from wittgenstein_tpu.core.registries import registry_batched_protocols
+
+# aggregation-family entries ride the fast tier (the lever's targets);
+# the rest of the registry is swept in the slow tier
+FAST_ENTRIES = ("handel", "p2phandel", "gsf", "pingpong")
+N_STEPS = 12
+
+
+def _entry_params():
+    params = []
+    for e in registry_batched_protocols.entries():
+        if not e.contract_checks:
+            continue
+        marks = [] if e.name in FAST_ENTRIES else [pytest.mark.slow]
+        params.append(pytest.param(e.name, marks=marks, id=e.name))
+    return params
+
+
+def _assert_bit_identical(a, b, tag):
+    eq = jax.tree_util.tree_map(
+        lambda x, y: bool(jnp.array_equal(x, y)), a, b
+    )
+    flat = jax.tree_util.tree_flatten_with_path(eq)[0]
+    bad = [jax.tree_util.keystr(kp) for kp, ok in flat if not ok]
+    assert not bad, f"{tag}: fused step diverges at leaves {bad[:6]}"
+
+
+@pytest.mark.parametrize("name", _entry_params())
+def test_fused_matches_unfused_registry(name):
+    entry = registry_batched_protocols.get(name)
+    net, state = entry.factory()
+    fnet = net.with_fuse_step(True)
+    assert fnet.cache_key() != net.cache_key()  # fresh jit identity
+    s_u, s_f = state, state
+    for _ in range(N_STEPS):
+        s_u = net.step(s_u)
+        s_f = fnet.step(s_f)
+    _assert_bit_identical(s_u, s_f, name)
+
+
+@pytest.mark.parametrize("wheel_rows", [0, 64], ids=["flat", "wheel64"])
+def test_fused_matches_unfused_handel_batched_run(wheel_rows):
+    """The flagship protocol through the real batched scan driver, both
+    store layouts, 2 diverging replicas."""
+    from wittgenstein_tpu.protocols.handel import HandelParameters
+    from wittgenstein_tpu.protocols.handel_batched import make_handel
+
+    net, state = make_handel(
+        HandelParameters(node_count=64), seed=1, wheel_rows=wheel_rows
+    )
+    states = jax.tree_util.tree_map(lambda x: jnp.stack([x, x]), state)
+    states = states._replace(seed=states.seed.at[1].set(77))
+    out_u = net.run_ms_batched(states, 120)
+    out_f = net.with_fuse_step(True).run_ms_batched(states, 120)
+    _assert_bit_identical(out_u, out_f, f"handel wheel_rows={wheel_rows}")
+
+
+def test_fused_matches_unfused_with_telemetry():
+    """Fusion folds the telemetry counter updates into its single
+    _replace — the side-car totals must still match the phased path."""
+    from wittgenstein_tpu.protocols.handel import HandelParameters
+    from wittgenstein_tpu.protocols.handel_batched import make_handel
+    from wittgenstein_tpu.telemetry.state import TelemetryConfig
+
+    net, state = make_handel(
+        HandelParameters(node_count=64), seed=1, wheel_rows=64
+    )
+    states = jax.tree_util.tree_map(lambda x: jnp.stack([x, x]), state)
+    tnet, tstates = net.with_telemetry(states, TelemetryConfig())
+    out_u = tnet.run_ms_batched(tstates, 100)
+    out_f = tnet.with_fuse_step(True).run_ms_batched(tstates, 100)
+    _assert_bit_identical(out_u, out_f, "handel wheel64 telemetry")
+
+
+def test_fuse_step_flag_is_static_engine_state():
+    from wittgenstein_tpu.protocols.pingpong_batched import make_pingpong
+
+    net, _ = make_pingpong(32)
+    assert net.fuse_step is False  # unfused stays the default
+    fnet = net.with_fuse_step(True)
+    assert fnet.fuse_step is True and net.fuse_step is False
+    # round-trips back off with a distinct cache identity
+    assert fnet.with_fuse_step(False).cache_key() == net.cache_key()

@@ -1,4 +1,4 @@
-"""Abstract-eval contract checks (SL401-SL404, SL406-SL407).
+"""Abstract-eval contract checks (SL401-SL404, SL406-SL407, SL701).
 
 These rules run the real engine code under JAX's abstract interpreter
 instead of reading its text: every protocol registered in
@@ -32,6 +32,13 @@ SL406  fault-off neutrality: a fault-enabled engine running the neutral
 SL407  fault-lane ownership: tracing deliver() on a fault-ENABLED
        delivery view, every state.faults leaf must be a pure
        passthrough — the engine owns the schedule and its counters.
+SL701  derived-cache consistency: a protocol declaring
+       DERIVED_CACHE_LEAVES (carried score/cardinality caches, the PR-8
+       hot-loop lever) must keep them equal to recompute_caches()'s
+       from-scratch values.  The entry is stepped concretely for several
+       ticks (so deliver, commits and periodic work all execute) and
+       every declared leaf is compared bitwise against the oracle — a
+       stale-cache bug cannot ship silently.
 
 Protocol-level suppression: list rule ids in the protocol class's
 SIMLINT_SUPPRESS tuple (the dynamic analog of `# simlint: disable=`).
@@ -375,6 +382,79 @@ def _check_fault_deliver_ownership(jax, name, net, state, path, line, suppress):
     return []
 
 
+def _check_derived_cache(jax, name, net, state, path, line, suppress):
+    """SL701: carried derived-cache leaves stay consistent with their
+    from-scratch recompute after concrete traced steps.  Skipped (clean)
+    when the protocol declares no DERIVED_CACHE_LEAVES."""
+    import numpy as np
+
+    leaves = tuple(getattr(net.protocol, "DERIVED_CACHE_LEAVES", ()) or ())
+    if not leaves:
+        return []
+    findings = []
+    proto = state.proto
+    if not isinstance(proto, dict):
+        f = _mk("SL701", path, line,
+                f"[{name}] declares DERIVED_CACHE_LEAVES {leaves} but "
+                "state.proto is not a dict, so the leaves cannot exist",
+                suppress)
+        return [f] if f else []
+    missing = [lf for lf in leaves if lf not in proto]
+    if missing:
+        f = _mk("SL701", path, line,
+                f"[{name}] DERIVED_CACHE_LEAVES {missing} not present in "
+                "the initial state.proto (proto_init must seed every "
+                "declared cache leaf)", suppress)
+        return [f] if f else []
+    try:
+        oracle = net.protocol.recompute_caches(state)
+    except Exception as e:
+        f = _mk("SL701", path, line,
+                f"[{name}] recompute_caches() failed on the initial "
+                f"state: {type(e).__name__}: {e}", suppress)
+        return [f] if f else []
+    uncovered = [lf for lf in leaves if lf not in oracle]
+    if uncovered:
+        f = _mk("SL701", path, line,
+                f"[{name}] recompute_caches() does not cover declared "
+                f"leaves {uncovered}; every DERIVED_CACHE_LEAVES entry "
+                "needs a from-scratch oracle", suppress)
+        return [f] if f else []
+
+    # concrete stepped consistency: enough ticks that delivery, commits
+    # and periodic beats all execute at least once at analysis scale
+    try:
+        stepped = state
+        for _ in range(8):
+            stepped = net.step(stepped)
+        fresh = net.protocol.recompute_caches(stepped)
+    except Exception as e:
+        f = _mk("SL701", path, line,
+                f"[{name}] concrete stepping for the cache-consistency "
+                f"check failed: {type(e).__name__}: {e}", suppress)
+        return [f] if f else []
+    for lf in leaves:
+        if lf not in stepped.proto or lf not in fresh:
+            f = _mk("SL701", path, line,
+                    f"[{name}] derived cache '{lf}' DISAPPEARED during "
+                    "stepping: a kernel hook rebuilt state.proto without "
+                    "carrying the declared cache leaf through", suppress)
+            if f:
+                findings.append(f)
+            continue
+        if not np.array_equal(
+            np.asarray(stepped.proto[lf]), np.asarray(fresh[lf])
+        ):
+            f = _mk("SL701", path, line,
+                    f"[{name}] derived cache '{lf}' is STALE: after 8 "
+                    "concrete steps the carried leaf differs bitwise from "
+                    "recompute_caches() — an update path (deliver/commit/"
+                    "select) forgot to maintain it", suppress)
+            if f:
+                findings.append(f)
+    return findings
+
+
 def _check_recompile(jax, name, net, state, out_shape, path, line, suppress):
     """SL404: step output avals == input avals (jit-cache stability) and
     trace determinism."""
@@ -411,8 +491,8 @@ def _check_recompile(jax, name, net, state, out_shape, path, line, suppress):
 
 
 def check_entry(entry, root: str = ".") -> List[Finding]:
-    """Run SL401-SL404 + SL406-SL407 for one registry entry; [] when
-    clean or when the entry opts out of contract checks (standalone
+    """Run SL401-SL404 + SL406-SL407 + SL701 for one registry entry; []
+    when clean or when the entry opts out of contract checks (standalone
     engines)."""
     jax = _cpu_jax()
     if not entry.contract_checks:
@@ -438,6 +518,9 @@ def check_entry(entry, root: str = ".") -> List[Finding]:
         jax, entry.name, net, state, path, line, suppress
     )
     findings += _check_fault_deliver_ownership(
+        jax, entry.name, net, state, path, line, suppress
+    )
+    findings += _check_derived_cache(
         jax, entry.name, net, state, path, line, suppress
     )
     findings += _check_recompile(

@@ -74,6 +74,11 @@ RULES: Dict[str, str] = {
     "SL601": "engine phase annotations: a live kernel phase is missing its "
     "named-scope marker in the step jaxpr, or annotations are not "
     "bit-neutral (annotate=False twin diverges)",
+    # -- derived-cache consistency --------------------------------------------
+    "SL701": "derived-cache consistency: a DERIVED_CACHE_LEAVES leaf is "
+    "stale after concrete steps (carried cache differs bitwise from "
+    "recompute_caches()), missing from proto_init, or uncovered by the "
+    "recompute oracle",
 }
 
 

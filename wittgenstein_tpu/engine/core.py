@@ -58,7 +58,12 @@ from ..faults.state import (
     neutral_fault_state,
     send_suppress,
 )
-from ..ops.bitops import lowest_set_bit, pack_bool_words, popcount_words
+from ..ops.bitops import (
+    bitops_backend,
+    lowest_set_bit,
+    pack_bool_words,
+    popcount_words,
+)
 from ..telemetry.state import (
     TelemetryConfig,
     count_by_type,
@@ -85,6 +90,7 @@ DEFAULT_WHEEL_ROWS = 512
 # "witt.send/witt.faults.send"), so consumers should substring-match.
 ENGINE_PHASE_SCOPES = {
     "delivery": "witt.delivery",
+    "fused_step": "witt.fused_step",
     "protocol_deliver": "witt.protocol_deliver",
     "send": "witt.send",
     "protocol_tick": "witt.protocol_tick",
@@ -203,6 +209,7 @@ class BatchedNetwork:
         telemetry: Optional[TelemetryConfig] = None,
         faults: Optional["FaultConfig"] = None,
         annotate: bool = True,
+        fuse_step: bool = False,
     ):
         self.protocol = protocol
         self.latency = latency
@@ -216,6 +223,16 @@ class BatchedNetwork:
         # program — kept only so simlint SL601 can prove the two are
         # bit-identical and bench can price the (nominally zero) overhead
         self.annotate = bool(annotate)
+        # STATIC switch for the fused delivery+tick step (_step_core_fused,
+        # docs/engine_fused_step.md): one traced phase instead of
+        # delivery -> send -> tick with full-state round-trips between
+        # them, plus a static empty-row clear that replaces the generic
+        # sort/repack when the delivery window is a single row.
+        # Bit-identical to the unfused path by construction (pinned by
+        # tests/test_step_fusion.py); the unfused path stays the default
+        # because its per-phase scopes are what --phase-profile and the
+        # SL601 annotation checks attribute against.
+        self.fuse_step = bool(fuse_step)
         # STATIC switch: None compiles the exact pre-telemetry program
         # (state.tele is an empty pytree); a TelemetryConfig threads the
         # counter side-car through every send/deliver/jump site below
@@ -358,6 +375,11 @@ class BatchedNetwork:
             self.telemetry.key() if self.telemetry is not None else None,
             self.faults.key() if self.faults is not None else None,
             self.annotate,
+            self.fuse_step,
+            # the bitset-kernel backend is read from the environment at
+            # trace time (WITT_BITOPS) — fold it in so a flipped override
+            # can't be served a stale compiled program
+            bitops_backend(),
         )
 
     def _scope(self, name: str):
@@ -432,6 +454,17 @@ class BatchedNetwork:
                 lambda a: jnp.broadcast_to(a, lead + tuple(jnp.shape(a))), fs
             )
         return net, state._replace(faults=fs)
+
+    def with_fuse_step(self, fuse: bool = True) -> "BatchedNetwork":
+        """Engine copy with the fused delivery+tick step toggled (fresh
+        jit identity via cache_key, same pattern as with_telemetry).
+        Fusion is a pure trace restructure — the returned engine accepts
+        the same states and produces bit-identical results."""
+        import copy
+
+        net = copy.copy(self)
+        net.fuse_step = bool(fuse)
+        return net
 
     # -- partitions (Network.partition, Network.java:693-707) ----------------
     @staticmethod
@@ -846,9 +879,16 @@ class BatchedNetwork:
         with self._scope("protocol_deliver"):
             pstate, emissions = self.protocol.deliver(self, vstate, deliver)
 
-        # clear due entries; surviving entries (a row visited early by a
-        # quantum window) repack to the slot prefix so whl_fill stays the
-        # next-free-slot index
+        state = self._clear_visited_rows(pstate, state, ctx, due)
+        return state, emissions
+
+    def _clear_visited_rows(self, pstate, state, ctx, due) -> SimState:
+        """Clear due entries from the visited window rows + overflow lane;
+        surviving entries (a row visited early by a quantum window) repack
+        to the slot prefix so whl_fill stays the next-free-slot index.
+        `pstate` carries the protocol's post-deliver columns; the wheel
+        fields are taken from the pre-view `state`."""
+        rows, wv, wa, wf, wt, wk, wp, q, b, _ = ctx
         keep = wv & ~due[: q * b].reshape(q, b)
         pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
         tgt = jnp.where(keep, pos, b)  # OOB -> dropped scatter
@@ -875,17 +915,118 @@ class BatchedNetwork:
             state = state._replace(
                 msg_payload=state.msg_payload.at[rows].set(np_)
             )
-        return state, emissions
+        return state
 
     # -- one millisecond (receiveUntil body, Network.java:586-632) -----------
     def _step_core(self, state: SimState) -> SimState:
         """One tick WITHOUT the time advance and WITHOUT tick_beat: wheel
         delivery + protocol.tick.  run_ms_batched's beat path guards
         tick_beat separately with a real branch."""
+        if self.fuse_step:
+            return self._step_core_fused(state)
         state, emissions = self._deliver_and_clear(state)
         state = self.apply_emissions(state, emissions)
         with self._scope("protocol_tick"):
             return self.protocol.tick(self, state)
+
+    def _step_core_fused(self, state: SimState) -> SimState:
+        """The fuse_step fast path (docs/engine_fused_step.md): the whole
+        deliver -> clear -> send -> tick sequence traced under ONE scope,
+        with the intermediate full-state round-trips removed — receiver
+        counters, telemetry and fault attribution land in a single
+        _replace together with the delivery view, and when the delivery
+        window is one row the post-deliver repack collapses to a static
+        empty-row fill (every valid entry in a singly-visited row is due:
+        eff-arrival ≡ row (mod W) and eff ∈ (insert, insert+W] pin the
+        visit tick to eff exactly, and jumps never overshoot an occupied
+        row).  Bit-identical to _step_core by construction; pinned across
+        every registered protocol by tests/test_step_fusion.py."""
+        with self._scope("fused_step"):
+            vview, due, deliver, ctx = self.delivery_view(state)
+            q, b = ctx[7], ctx[8]
+            fault_supp = ctx[9]
+            view_to = vview.msg_to
+            view_type = vview.msg_type
+            sizes = jnp.asarray(self._msg_sizes, jnp.int32)[view_type]
+            dm = (deliver & (sizes > 0)).astype(jnp.int32)
+            upd = dict(
+                msg_received=state.msg_received.at[view_to].add(
+                    dm, mode="drop"
+                ),
+                bytes_received=state.bytes_received.at[view_to].add(
+                    dm * sizes, mode="drop"
+                ),
+            )
+            if self.telemetry is not None:
+                tele = state.tele
+                upd["tele"] = tele._replace(
+                    delivered=count_by_type(tele.delivered, deliver, view_type),
+                    discarded=count_by_type(
+                        tele.discarded, due & ~deliver, view_type
+                    ),
+                )
+            if self.faults is not None:
+                fs = state.faults
+                upd["faults"] = fs._replace(
+                    dropped_by_fault=count_by_type(
+                        fs.dropped_by_fault, fault_supp, view_type
+                    )
+                )
+            # one _replace: counters + side-cars + the flat delivery view
+            vstate = state._replace(
+                msg_valid=vview.msg_valid,
+                msg_arrival=vview.msg_arrival,
+                msg_from=vview.msg_from,
+                msg_to=vview.msg_to,
+                msg_type=vview.msg_type,
+                msg_payload=vview.msg_payload,
+                **upd,
+            )
+            with self._scope("protocol_deliver"):
+                pstate, emissions = self.protocol.deliver(
+                    self, vstate, deliver
+                )
+            if q == 1:
+                # all-due invariant: the visited row empties entirely, so
+                # the sort/cumsum/scatter repack is a constant fill (in
+                # flat mode the degenerate 1x1 row is never occupied and
+                # the same constants are what it already holds)
+                w_shape = (q, b)
+                state = pstate._replace(
+                    msg_valid=state.msg_valid.at[ctx[0]].set(
+                        jnp.zeros(w_shape, bool)
+                    ),
+                    msg_arrival=state.msg_arrival.at[ctx[0]].set(
+                        jnp.full(w_shape, INT_MAX, jnp.int32)
+                    ),
+                    msg_from=state.msg_from.at[ctx[0]].set(
+                        jnp.zeros(w_shape, jnp.int32)
+                    ),
+                    msg_to=state.msg_to.at[ctx[0]].set(
+                        jnp.zeros(w_shape, jnp.int32)
+                    ),
+                    msg_type=state.msg_type.at[ctx[0]].set(
+                        jnp.zeros(w_shape, jnp.int32)
+                    ),
+                    msg_payload=(
+                        state.msg_payload.at[ctx[0]].set(
+                            jnp.zeros(
+                                w_shape + (self.payload_width,), jnp.int32
+                            )
+                        )
+                        if self.payload_width
+                        else state.msg_payload
+                    ),
+                    whl_fill=state.whl_fill.at[ctx[0]].set(
+                        jnp.zeros(q, jnp.int32)
+                    ),
+                    ovf_valid=state.ovf_valid & ~due[q * b :],
+                )
+            else:
+                state = self._clear_visited_rows(pstate, state, ctx, due)
+            state = self.apply_emissions(state, emissions)
+            with self._scope("protocol_tick"):
+                return self.protocol.tick(self, state)
 
     # -- phase hooks (bench --phase-profile) ---------------------------------
     def _phase_deliver(self, state: SimState) -> SimState:

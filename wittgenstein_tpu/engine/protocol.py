@@ -95,6 +95,14 @@ class BatchedProtocol:
     # abstract-eval checks — the dynamic analog of the per-line
     # `# simlint: disable=RULE` comment.  Use sparingly, with a comment.
     SIMLINT_SUPPRESS: tuple = ()
+    # Names of state.proto leaves that are DERIVED caches: redundant
+    # values (candidate-score caches, cached cardinalities) recomputable
+    # from the authoritative leaves at any tick boundary.  A protocol
+    # declaring leaves here must also override recompute_caches();
+    # simlint SL701 steps the protocol concretely and asserts the carried
+    # caches match a from-scratch recompute bitwise, so stale-cache bugs
+    # can't ship silently.
+    DERIVED_CACHE_LEAVES: tuple = ()
 
     def contract(self) -> dict:
         """Machine-readable contract summary (instance-level: factories may
@@ -115,6 +123,7 @@ class BatchedProtocol:
             "engine_owned_fields": list(ENGINE_OWNED_FIELDS),
             "deliver_may_touch": list(self.DELIVER_MAY_TOUCH),
             "simlint_suppress": list(self.SIMLINT_SUPPRESS),
+            "derived_cache_leaves": list(self.DERIVED_CACHE_LEAVES),
         }
 
     def n_msg_types(self) -> int:
@@ -159,6 +168,13 @@ class BatchedProtocol:
         phase order interleaves dense and beat-gated phases, e.g.
         HandelEth2's commit -> start/stop+dissemination -> select)."""
         return state
+
+    def recompute_caches(self, state) -> dict:
+        """From-scratch values for every DERIVED_CACHE_LEAVES leaf, as a
+        {leaf_name: array} dict computed from the authoritative proto
+        leaves only.  The consistency oracle for simlint SL701 and the
+        cache-equivalence tests; must be traceable."""
+        return {}
 
     # -- termination ----------------------------------------------------------
     def all_done(self, state) -> jnp.ndarray:

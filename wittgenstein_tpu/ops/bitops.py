@@ -13,6 +13,8 @@ leading axes.
 
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -22,19 +24,45 @@ _BUTTERFLY_MASKS = np.array(
     [0x55555555, 0x33333333, 0x0F0F0F0F, 0x00FF00FF, 0x0000FFFF], dtype=np.uint32
 )
 
+BITOPS_ENV = "WITT_BITOPS"  # "lax" | "pallas" (anything else = auto)
 
-def popcount_words(words) -> jnp.ndarray:
-    """Total set bits over the last axis of packed uint32 words."""
+
+def bitops_backend() -> str:
+    """The bitset-kernel backend for the NEXT trace: "lax" or "pallas".
+
+    `WITT_BITOPS=lax|pallas` overrides; otherwise pallas is auto-selected
+    on a TPU backend only (the kernels interpret rather than compile
+    anywhere else — correct but slow, so CPU/GPU default to lax).  Read
+    at trace time, so it is a static program property; the engine folds
+    it into `cache_key()` so a flipped env var cannot hit a stale jit
+    cache."""
+    env = os.environ.get(BITOPS_ENV, "").strip().lower()
+    if env in ("lax", "pallas"):
+        return env
+    try:
+        import jax
+
+        return "pallas" if jax.default_backend() == "tpu" else "lax"
+    except Exception:  # no backend yet — the safe default
+        return "lax"
+
+
+def _popcount_words_lax(words) -> jnp.ndarray:
     return jnp.sum(
         lax.population_count(words.astype(jnp.uint32)).astype(jnp.int32), axis=-1
     )
 
 
-def pack_bool_words(bits) -> jnp.ndarray:
-    """Pack a bool vector into uint32 words over the last axis:
-    [..., W] bool -> [..., ceil(W/32)] uint32, bit j of word k = element
-    32k + j.  (The engine's wheel-occupancy summary; pairs with
-    popcount_words / lowest_set_bit.)"""
+def popcount_words(words) -> jnp.ndarray:
+    """Total set bits over the last axis of packed uint32 words."""
+    if bitops_backend() == "pallas":
+        from .bitops_pallas import popcount_words_pallas
+
+        return popcount_words_pallas(words)
+    return _popcount_words_lax(words)
+
+
+def _pack_bool_words_lax(bits) -> jnp.ndarray:
     bits = jnp.asarray(bits, bool)
     w = bits.shape[-1]
     pad = (-w) % WORD
@@ -49,15 +77,37 @@ def pack_bool_words(bits) -> jnp.ndarray:
     )
 
 
-def lowest_set_bit(words) -> jnp.ndarray:
-    """Index of the lowest set bit over the last axis of packed [..., w]
-    uint32 vectors (undefined when empty — gate on popcount > 0)."""
+def pack_bool_words(bits) -> jnp.ndarray:
+    """Pack a bool vector into uint32 words over the last axis:
+    [..., W] bool -> [..., ceil(W/32)] uint32, bit j of word k = element
+    32k + j.  (The engine's wheel-occupancy summary; pairs with
+    popcount_words / lowest_set_bit.)"""
+    if bitops_backend() == "pallas":
+        from .bitops_pallas import pack_bool_words_pallas
+
+        return pack_bool_words_pallas(bits)
+    return _pack_bool_words_lax(bits)
+
+
+def _lowest_set_bit_lax(words) -> jnp.ndarray:
     words = words.astype(jnp.uint32)
     word_nz = words != 0
     widx = jnp.argmax(word_nz, axis=-1).astype(jnp.int32)
     wval = jnp.take_along_axis(words, widx[..., None], axis=-1)[..., 0]
-    lowbit = popcount_words(((wval & (-wval).astype(jnp.uint32)) - 1)[..., None])
+    lowbit = _popcount_words_lax(
+        ((wval & (-wval).astype(jnp.uint32)) - 1)[..., None]
+    )
     return widx * WORD + lowbit
+
+
+def lowest_set_bit(words) -> jnp.ndarray:
+    """Index of the lowest set bit over the last axis of packed [..., w]
+    uint32 vectors (undefined when empty — gate on popcount > 0)."""
+    if bitops_backend() == "pallas":
+        from .bitops_pallas import lowest_set_bit_pallas
+
+        return lowest_set_bit_pallas(words)
+    return _lowest_set_bit_lax(words)
 
 
 def xor_shuffle(words, v):

@@ -1,0 +1,181 @@
+"""Pallas kernels for the packed-bitset hot path (ops.bitops).
+
+`ops.bitops` auto-selects these on a TPU backend (or when forced with
+`WITT_BITOPS=pallas`); the lax implementations remain the
+always-available fallback and the bit-identity reference.  Every kernel
+here must produce bit-identical results to its lax twin — pinned by
+tests/test_bitops_pallas.py, which runs the kernels in interpret mode
+on CPU over odd shapes and the all-zero / all-ones edge cases.
+
+Geometry: callers pass arbitrary leading axes over a packed word axis
+(`[..., w]` uint32).  The wrappers flatten to `[M, w]` rows and tile the
+grid over row blocks only — flagship word widths (w_pad ∈ {1..128} at
+4096 nodes) fit a VMEM row comfortably, so the word axis stays whole
+per block.  Row blocks are sized to the next power of two up to
+`MAX_ROW_BLOCK`; on a real TPU the word axis is additionally padded to
+the 128-lane tile (zero words are neutral for all three kernels), which
+is what "block specs sized for the flagship shapes" means in practice.
+
+Inside kernels, population counts use the SWAR ladder instead of
+`lax.population_count` — Mosaic has no popcount primitive, and the SWAR
+form lowers on every backend with identical integer results.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+WORD = 32
+MIN_ROW_BLOCK = 8
+MAX_ROW_BLOCK = 512
+LANE = 128  # TPU minor-dim tile
+
+
+def _interpret() -> bool:
+    """Interpret off-TPU: these kernels only compile under Mosaic."""
+    return jax.default_backend() != "tpu"
+
+
+def _row_block(m: int) -> int:
+    """Power-of-two row-block size for M rows, in [MIN, MAX]_ROW_BLOCK."""
+    b = 1 << max(0, m - 1).bit_length()
+    return max(MIN_ROW_BLOCK, min(MAX_ROW_BLOCK, b))
+
+
+def _swar_popcount(v):
+    """Per-word set-bit count of uint32 lanes (SWAR ladder) -> int32."""
+    v = v - ((v >> 1) & jnp.uint32(0x55555555))
+    v = (v & jnp.uint32(0x33333333)) + ((v >> 2) & jnp.uint32(0x33333333))
+    v = (v + (v >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return ((v * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
+
+
+def _rows(x, pad_value, lane_pad: bool):
+    """Flatten [..., w] to a row-block-padded [M', w'] plus the slicing
+    info to undo it."""
+    lead, w = x.shape[:-1], x.shape[-1]
+    m = 1
+    for d in lead:
+        m *= d
+    flat = x.reshape(m, w)
+    if lane_pad and w % LANE:
+        flat = jnp.concatenate(
+            [flat, jnp.full((m, (-w) % LANE), pad_value, x.dtype)], axis=-1
+        )
+    bm = _row_block(m)
+    rpad = (-m) % bm
+    if rpad:
+        flat = jnp.concatenate(
+            [flat, jnp.full((rpad, flat.shape[-1]), pad_value, x.dtype)]
+        )
+    return flat, bm, m, lead
+
+
+def _popcount_kernel(x_ref, o_ref):
+    o_ref[...] = jnp.sum(_swar_popcount(x_ref[...]), axis=-1)
+
+
+def popcount_words_pallas(words, lane_pad=None) -> jnp.ndarray:
+    """Pallas twin of bitops.popcount_words: [..., w] uint32 -> [...]
+    int32 total set bits.  Zero lane padding is count-neutral."""
+    interpret = _interpret()
+    if lane_pad is None:
+        lane_pad = not interpret
+    flat, bm, m, lead = _rows(
+        words.astype(jnp.uint32), jnp.uint32(0), lane_pad
+    )
+    out = pl.pallas_call(
+        _popcount_kernel,
+        out_shape=jax.ShapeDtypeStruct((flat.shape[0],), jnp.int32),
+        in_specs=[pl.BlockSpec((bm, flat.shape[1]), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((bm,), lambda i: (i,)),
+        grid=(flat.shape[0] // bm,),
+        interpret=interpret,
+    )(flat)
+    return out[:m].reshape(lead)
+
+
+def _pack_kernel(x_ref, o_ref):
+    b = x_ref[...]
+    bm, wp = b.shape
+    grouped = b.reshape(bm, wp // WORD, WORD)
+    weights = jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32)
+    o_ref[...] = jnp.sum(grouped.astype(jnp.uint32) * weights, axis=-1).astype(
+        jnp.uint32
+    )
+
+
+def pack_bool_words_pallas(bits, lane_pad=None) -> jnp.ndarray:
+    """Pallas twin of bitops.pack_bool_words: [..., W] bool ->
+    [..., ceil(W/32)] uint32.  The bit axis is padded to a word multiple
+    exactly like the lax path (extra zero bits pack to zero words, and
+    extra lane-pad words are sliced off the output)."""
+    interpret = _interpret()
+    if lane_pad is None:
+        lane_pad = not interpret
+    bits = jnp.asarray(bits, bool)
+    w = bits.shape[-1]
+    nw = (w + WORD - 1) // WORD
+    pad = nw * WORD - w
+    if pad:
+        bits = jnp.concatenate(
+            [bits, jnp.zeros(bits.shape[:-1] + (pad,), bool)], axis=-1
+        )
+    # lane padding happens on the BIT axis (32 bits per output word)
+    flat, bm, m, lead = _rows(bits, False, False)
+    if lane_pad and nw % LANE:
+        wpad = ((-nw) % LANE) * WORD
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((flat.shape[0], wpad), bool)], axis=-1
+        )
+    nw_p = flat.shape[1] // WORD
+    out = pl.pallas_call(
+        _pack_kernel,
+        out_shape=jax.ShapeDtypeStruct((flat.shape[0], nw_p), jnp.uint32),
+        in_specs=[pl.BlockSpec((bm, flat.shape[1]), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((bm, nw_p), lambda i: (i, 0)),
+        grid=(flat.shape[0] // bm,),
+        interpret=interpret,
+    )(flat)
+    return out[:m, :nw].reshape(lead + (nw,))
+
+
+def _lowest_kernel(x_ref, o_ref):
+    v = x_ref[...]
+    w = v.shape[-1]
+    # per-word lowest-bit index; a zero word yields 32 (popcount of ~0)
+    low = v & (~v + jnp.uint32(1))
+    lowbit = _swar_popcount(low - jnp.uint32(1))
+    idx = jnp.arange(w, dtype=jnp.int32) * WORD + lowbit
+    # zero words can't shadow the first set word: any candidate from a
+    # later word j > j0 is >= 32*j > 32*j0 + 31
+    cand = jnp.where(v != jnp.uint32(0), idx, jnp.int32(WORD * (w + 1)))
+    best = jnp.min(cand, axis=-1)
+    # empty vectors: the lax path lands on word 0 -> 0*32 + 32
+    o_ref[...] = jnp.where(
+        jnp.any(v != jnp.uint32(0), axis=-1), best, jnp.int32(WORD)
+    )
+
+
+def lowest_set_bit_pallas(words, lane_pad=None) -> jnp.ndarray:
+    """Pallas twin of bitops.lowest_set_bit: [..., w] uint32 -> [...]
+    int32 index of the lowest set bit (32 for the all-zero vector,
+    matching the lax path's argmax-of-nothing behavior).  Zero lane
+    padding is neutral: padded words never win the min."""
+    interpret = _interpret()
+    if lane_pad is None:
+        lane_pad = not interpret
+    flat, bm, m, lead = _rows(
+        words.astype(jnp.uint32), jnp.uint32(0), lane_pad
+    )
+    out = pl.pallas_call(
+        _lowest_kernel,
+        out_shape=jax.ShapeDtypeStruct((flat.shape[0],), jnp.int32),
+        in_specs=[pl.BlockSpec((bm, flat.shape[1]), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((bm,), lambda i: (i,)),
+        grid=(flat.shape[0] // bm,),
+        interpret=interpret,
+    )(flat)
+    return out[:m].reshape(lead)

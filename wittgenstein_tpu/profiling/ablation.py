@@ -69,6 +69,23 @@ def _lever_builders(node_ct: int) -> Dict[str, Callable]:
     def wheel():
         return make_handel(p(), wheel_rows=WHEEL_LEVER_ROWS)
 
+    def score_cache_on():
+        return make_handel(p(), score_cache=True)
+
+    def score_cache_off():
+        return make_handel(p(), score_cache=False)
+
+    def fuse_step():
+        return make_handel(p(), fuse_step=True)
+
+    def wheel_fused():
+        return make_handel(p(), wheel_rows=WHEEL_LEVER_ROWS, fuse_step=True)
+
+    def bitops_pallas():
+        # the flip happens via LEVER_ENV (WITT_BITOPS=pallas is read at
+        # trace time and folded into the engine cache_key)
+        return make_handel(p())
+
     def telemetry_on():
         from ..telemetry import TelemetryConfig
 
@@ -88,6 +105,11 @@ def _lever_builders(node_ct: int) -> Dict[str, Callable]:
         "boundary_view_off": boundary_view_off,
         "pre_r5": pre_r5,
         "wheel": wheel,
+        "score_cache_on": score_cache_on,
+        "score_cache_off": score_cache_off,
+        "fuse_step": fuse_step,
+        "wheel_fused": wheel_fused,
+        "bitops_pallas": bitops_pallas,
         "telemetry_on": telemetry_on,
         "faults_on": faults_on,
         "annotations_off": annotations_off,
@@ -95,17 +117,50 @@ def _lever_builders(node_ct: int) -> Dict[str, Callable]:
 
 
 LEVER_NOTES = {
-    "base": "current flagship config (r5+): D=32, boundary view, flat, bare",
+    "base": "current flagship config (r5+): D=32, boundary view, flat, "
+    "bare, score cache backend-auto",
     "channel_depth_8": "r4 channel depth (D=8 vs 32) — the displacement fix's price",
     "boundary_view_off": "pre-r5 same-tick selection (NOT parity-correct)",
     "pre_r5": "both r5 parity levers off — the r4 hot loop",
     "wheel": f"time-wheel store (wheel_rows={WHEEL_LEVER_ROWS}) vs flat",
+    "score_cache_on": "carried candidate-score caches PINNED ON (base is "
+    "backend-auto: on-TPU only) — on CPU this row prices the cache's "
+    "maintenance cost, on TPU it ~= base",
+    "score_cache_off": "carried candidate-score caches PINNED OFF — full "
+    "popcount recompute (on TPU this row prices lever 1; on CPU it ~= "
+    "base)",
+    "fuse_step": "delivery+tick fused under one scope (flat: ~0 on CPU — "
+    "run-to-run noise dominates; see wheel_fused)",
+    "wheel_fused": "fused step on the wheel store — measured against `wheel`, not base",
+    "bitops_pallas": "Pallas bitset kernels (interpret-mode penalty off-TPU; real lever on TPU)",
     "telemetry_on": "in-graph counter side-car armed",
     "faults_on": "fault side-car armed, neutral schedule",
     "annotations_off": "named-scope phase markers stripped (overhead bound)",
 }
 
-SMOKE_LEVERS = ("base", "channel_depth_8", "boundary_view_off", "pre_r5")
+# per-lever env overrides, applied around BOTH the build and the timed
+# trace (bitops_backend() is read at trace time) and restored afterwards
+LEVER_ENV: Dict[str, Dict[str, str]] = {
+    "bitops_pallas": {"WITT_BITOPS": "pallas"},
+}
+
+# levers whose delta is measured against a config OTHER than base
+# (wheel_fused prices fusion where delivery is wide; against base it
+# would mostly re-measure the wheel-vs-flat delta)
+LEVER_BASELINE: Dict[str, str] = {
+    "wheel_fused": "wheel",
+}
+
+SMOKE_LEVERS = (
+    "base",
+    "channel_depth_8",
+    "boundary_view_off",
+    "pre_r5",
+    "score_cache_on",
+    "score_cache_off",
+    "fuse_step",
+    "bitops_pallas",
+)
 
 
 def smoke_ablation_configs() -> List[str]:
@@ -137,15 +192,27 @@ def ablation_matrix(
     if "base" not in names:
         names = ["base"] + list(names)
 
+    import os
+
     configs: Dict[str, dict] = {}
     for name in names:
-        net, state = builders[name]()
-        states = replicate_state(state, n_replicas)
-        states = net.run_ms_batched(states, warm_ms)  # realistic occupancy
-        jax.block_until_ready(states)
-        t = scan_phase_seconds(
-            states, {"full_step": net.step}, scans, tracer, repeats=repeats
-        )["full_step"]
+        env = LEVER_ENV.get(name, {})
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            net, state = builders[name]()
+            states = replicate_state(state, n_replicas)
+            states = net.run_ms_batched(states, warm_ms)  # realistic occupancy
+            jax.block_until_ready(states)
+            t = scan_phase_seconds(
+                states, {"full_step": net.step}, scans, tracer, repeats=repeats
+            )["full_step"]
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
         configs[name] = {
             "tick_us": round(t["mean_s"] * 1e6, 2),
             "std_us": round(t["std_s"] * 1e6, 2),
@@ -170,30 +237,36 @@ def lever_report(matrix: dict) -> dict:
     regression into its two named levers + interaction residual.
 
     Sign convention: delta_us > 0 means the LEVER CONFIG is cheaper
-    than base by that much per tick — i.e. the base config PAYS
-    delta_us for what the lever removes."""
+    than its comparison config (base, or LEVER_BASELINE[name]) by that
+    much per tick — i.e. the comparison config PAYS delta_us for what
+    the lever removes."""
     configs = matrix["configs"]
     base = configs["base"]
     levers = []
     for name, c in configs.items():
         if name == "base":
             continue
-        delta = base["tick_us"] - c["tick_us"]
-        spread = 2.0 * (base["std_us"] + c["std_us"])
-        levers.append(
-            {
-                "lever": name,
-                "tick_us": c["tick_us"],
-                "delta_us": round(delta, 2),
-                "delta_pct_of_base": (
-                    round(delta / base["tick_us"] * 100, 1)
-                    if base["tick_us"]
-                    else None
-                ),
-                "trustworthy": abs(delta) > spread,
-                "note": c.get("note", ""),
-            }
-        )
+        cmp_name = LEVER_BASELINE.get(name, "base")
+        cmp_cfg = configs.get(cmp_name, base)
+        if cmp_name not in configs:
+            cmp_name = "base"
+        delta = cmp_cfg["tick_us"] - c["tick_us"]
+        spread = 2.0 * (cmp_cfg["std_us"] + c["std_us"])
+        row = {
+            "lever": name,
+            "tick_us": c["tick_us"],
+            "delta_us": round(delta, 2),
+            "delta_pct_of_base": (
+                round(delta / cmp_cfg["tick_us"] * 100, 1)
+                if cmp_cfg["tick_us"]
+                else None
+            ),
+            "trustworthy": abs(delta) > spread,
+            "note": c.get("note", ""),
+        }
+        if cmp_name != "base":
+            row["vs"] = cmp_name
+        levers.append(row)
     levers.sort(key=lambda r: -abs(r["delta_us"]))
 
     report = {
@@ -245,9 +318,10 @@ def format_lever_report(report: dict) -> str:
     ]
     for r in report["ranked_levers"]:
         trust = "ok " if r["trustworthy"] else "~? "
+        vs = f" [vs {r['vs']}]" if r.get("vs") else ""
         lines.append(
             f"{r['lever']:<20} {r['tick_us']:>9.1f} {r['delta_us']:>8.1f}"
-            f" {r['delta_pct_of_base'] or 0:>5.1f}%  {trust} {r['note']}"
+            f" {r['delta_pct_of_base'] or 0:>5.1f}%  {trust} {r['note']}{vs}"
         )
     attr = report.get("r4_to_r5_attribution")
     if attr:

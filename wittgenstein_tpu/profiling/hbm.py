@@ -92,14 +92,38 @@ def hbm_report(
     if memory:
         # measured live bytes for 1 replica vs the modeled
         # bytes_per_replica * overhead — how honest is the 2x factor?
+        # live_bytes = argument + output + temp; the argument/output pair
+        # is state-shaped (the two live copies the 2x overhead models),
+        # temp is XLA fusion scratch on top.  Both ratios are reported so
+        # a temp-heavy compile (ratio gap) is visible instead of folded
+        # into one misleading 0.6x number.
         live = memory.get("live_bytes", 0)
+        arg_b = memory.get("argument_size_in_bytes", 0)
+        out_b = memory.get("output_size_in_bytes", 0)
+        temp_b = memory.get("temp_size_in_bytes", 0)
         modeled = density["bytes_per_replica"] * density["overhead_factor"]
+        state_shaped = arg_b + out_b
         out["measured"] = {
+            "argument_bytes": arg_b,
+            "output_bytes": out_b,
+            "temp_bytes": temp_b,
+            "temp_share_of_live": (
+                round(temp_b / live, 3) if live else None
+            ),
             "live_bytes_1_replica": live,
-            "temp_bytes": memory.get("temp_size_in_bytes", 0),
             "modeled_bytes": int(modeled),
+            "model_over_state_bytes": (
+                round(modeled / state_shaped, 2) if state_shaped else None
+            ),
             "model_over_measured": (
                 round(modeled / live, 2) if live else None
+            ),
+            "note": (
+                "replicas_per_chip is fed by the MODEL"
+                " (bytes_per_replica * overhead_factor), never by these"
+                " measured numbers; model_over_measured < 1 means XLA"
+                " temps exceed the overhead headroom at this geometry"
+                " (see temp_share_of_live)"
             ),
         }
     return out

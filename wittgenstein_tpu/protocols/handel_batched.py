@@ -126,6 +126,20 @@ class BatchedHandel(BitsetAggBase):
     # dicts the view costs per tick; False reproduces the pre-r5
     # one-tick-lead selection and is NOT parity-correct.
     BOUNDARY_VIEW = True
+    # Candidate-score caching (the PR-8 lever): carry the per-slot derived
+    # quantities _select needs — sizeIfIncluded, cardinality, |sig ∪ ind|
+    # and the agg-intersection flag — as int32 leaves in state.proto,
+    # refreshed only where delivery merges new content and where _commit
+    # moves the aggregates.  The selection and the channel merge then read
+    # cached int32 columns instead of re-popcounting every candidate's
+    # signature words each tick (the top bytes-accessed term in
+    # BUDGET.json).  End-of-tick invariant, pinned by simlint SL701 and
+    # tests/test_score_cache.py: each cache leaf equals its from-scratch
+    # recompute (_recompute_cache_dict) from (cand_sig*, inc, ind, agg).
+    # False restores the uncached program, leaf-for-leaf identical to the
+    # pre-cache tree (the ablation's score_cache_off lever).
+    SCORE_CACHE = True
+    CACHE_LEAF_NAMES = ("cand_s", "cand_card", "cand_wind", "cand_aggi")
 
     def __init__(self, params: HandelParameters):
         self.params = params
@@ -136,6 +150,9 @@ class BatchedHandel(BitsetAggBase):
                 )
             self.CHANNEL_DEPTH = params.channel_depth  # instance override
         self._init_geometry(params.node_count)
+        self.DERIVED_CACHE_LEAVES = (
+            self.CACHE_LEAF_NAMES if self.SCORE_CACHE else ()
+        )
 
     def msg_size(self, mtype: int) -> int:
         # Size = level + bit field + the signatures included + our own sig
@@ -202,7 +219,7 @@ class BatchedHandel(BitsetAggBase):
             f"cand_sig{i}": jnp.zeros((n, b.nl * K * b.w_pad), jnp.uint32)
             for i, b in enumerate(self.buckets)
         }
-        return {
+        proto = {
             "agg": jnp.asarray(own),  # lastAggVerified per level block
             "ind": jnp.asarray(own),  # verifiedIndSignatures
             "inc": jnp.asarray(own),  # totalIncoming = agg | ind
@@ -236,6 +253,50 @@ class BatchedHandel(BitsetAggBase):
             "pairing": jnp.asarray(pairing, jnp.int32),
             "start_at": jnp.asarray(start_at, jnp.int32),
         }
+        if self.SCORE_CACHE:
+            proto.update(self._recompute_cache_dict(proto))
+        return proto
+
+    # -- candidate-score caches (SCORE_CACHE) --------------------------------
+    def _recompute_cache_dict(self, proto) -> dict:
+        """From-scratch values of the four candidate-score cache leaves,
+        computed only from (cand_sig*, inc, ind, agg) — the oracle the
+        end-of-tick invariant is checked against (simlint SL701) and the
+        initializer for proto_init.  Per slot k of (receiver, level):
+          cand_s    = sizeIfIncluded (bestToVerify's curation quantity,
+                      Handel.java:592-612): |merge(sig, inc) ∪ ind|
+          cand_card = |sig|
+          cand_wind = |sig ∪ ind|   (the score's with-individuals term)
+          cand_aggi = 1 iff sig ∩ lastAgg ≠ ∅  (the score's branch flag)
+        All int32 [N, (L-1)*K], addressed exactly like cand_rank."""
+        n, L, K = self.n_nodes, self.n_levels, self.CAND_SLOTS
+        inc, ind, agg = proto["inc"], proto["ind"], proto["agg"]
+        s_p, card_p, wind_p, aggi_p = [], [], [], []
+        for i, b in enumerate(self.buckets):
+            c_sig = self._sig_view(proto, i, K, prefix="cand_sig")
+            inc_b = self._blocks(inc, b)[:, :, None, :]
+            ind_b = self._blocks(ind, b)[:, :, None, :]
+            agg_b = self._blocks(agg, b)[:, :, None, :]
+            inter = popcount_words(c_sig & inc_b) > 0
+            cc = jnp.where(inter[..., None], c_sig, c_sig | inc_b)
+            s_p.append(popcount_words(cc | ind_b))
+            card_p.append(popcount_words(c_sig))
+            wind_p.append(popcount_words(c_sig | ind_b))
+            aggi_p.append(
+                (popcount_words(c_sig & agg_b) > 0).astype(jnp.int32)
+            )
+        flat = lambda ps: jnp.concatenate(ps, axis=1).reshape(n, (L - 1) * K)
+        return {
+            "cand_s": flat(s_p),
+            "cand_card": flat(card_p),
+            "cand_wind": flat(wind_p),
+            "cand_aggi": flat(aggi_p),
+        }
+
+    def recompute_caches(self, state) -> dict:
+        if not self.SCORE_CACHE:
+            return {}
+        return self._recompute_cache_dict(state.proto)
 
     # -- tick phase 1: commit due verifications ------------------------------
     def _commit(self, net, state):
@@ -309,6 +370,51 @@ class BatchedHandel(BitsetAggBase):
         done_now = (
             improved_any & (state.done_at == 0) & ~state.down & (total >= p.threshold)
         )
+        cache_fix = {}
+        if self.SCORE_CACHE:
+            # a good commit moves (inc, ind, agg) at exactly ver_level, so
+            # the score caches of that one level's K slots are re-derived
+            # against the NEW aggregates; every other level's caches stay
+            # valid (cand_card depends on sig content only — untouched)
+            K = self.CAND_SLOTS
+            cs3 = proto["cand_s"].reshape(n, L - 1, K)
+            cw3 = proto["cand_wind"].reshape(n, L - 1, K)
+            ca3 = proto["cand_aggi"].reshape(n, L - 1, K)
+            lv_rows = jnp.arange(L - 1, dtype=jnp.int32)
+            for i, b in enumerate(self.buckets):
+                mlev = good & (lvl >= b.lo) & (lvl <= b.hi)
+                li = jnp.clip(lvl - b.lo, 0, b.nl - 1)
+                c_sig = self._sig_view(proto, i, K, prefix="cand_sig")
+                sig_lv = jnp.take_along_axis(
+                    c_sig, li[:, None, None, None], axis=1
+                )[:, 0]  # [N, K, w_pad]
+                inc_lv = jnp.take_along_axis(
+                    self._blocks(inc, b), li[:, None, None], axis=1
+                )[:, 0]
+                ind_lv = jnp.take_along_axis(
+                    self._blocks(ind, b), li[:, None, None], axis=1
+                )[:, 0]
+                agg_lv = jnp.take_along_axis(
+                    self._blocks(agg, b), li[:, None, None], axis=1
+                )[:, 0]
+                inter = popcount_words(sig_lv & inc_lv[:, None, :]) > 0
+                cc = jnp.where(
+                    inter[..., None], sig_lv, sig_lv | inc_lv[:, None, :]
+                )
+                s_lv = popcount_words(cc | ind_lv[:, None, :])
+                wind_lv = popcount_words(sig_lv | ind_lv[:, None, :])
+                aggi_lv = (
+                    popcount_words(sig_lv & agg_lv[:, None, :]) > 0
+                ).astype(jnp.int32)
+                lm = mlev[:, None] & (lv_rows[None, :] == (lvl - 1)[:, None])
+                cs3 = jnp.where(lm[..., None], s_lv[:, None, :], cs3)
+                cw3 = jnp.where(lm[..., None], wind_lv[:, None, :], cw3)
+                ca3 = jnp.where(lm[..., None], aggi_lv[:, None, :], ca3)
+            cache_fix = {
+                "cand_s": cs3.reshape(n, (L - 1) * K),
+                "cand_wind": cw3.reshape(n, (L - 1) * K),
+                "cand_aggi": ca3.reshape(n, (L - 1) * K),
+            }
         state = state._replace(
             done_at=jnp.where(done_now, t, state.done_at),
             proto=dict(
@@ -318,6 +424,7 @@ class BatchedHandel(BitsetAggBase):
                 inc=inc,
                 bl=new_bl,
                 ver_active=proto["ver_active"] & ~due,
+                **cache_fix,
             ),
         )
 
@@ -429,7 +536,9 @@ class BatchedHandel(BitsetAggBase):
         rank2 = jnp.where(accept, rank2, INT32_MAX)
 
         inc, ind, bl = proto["inc"], proto["ind"], proto["bl"]
+        agg = proto["agg"]
         rank_pieces, rel_pieces = [], []
+        s_pieces, card_pieces, wind_pieces, aggi_pieces = [], [], [], []
         cand_sig_updates = {}
         for i, b in enumerate(self.buckets):
             sl = slice(b.lo - 1, b.hi)  # level rows of this bucket
@@ -449,11 +558,51 @@ class BatchedHandel(BitsetAggBase):
 
             inc_b = self._blocks(inc, b)  # [N, nl, w_pad]
             ind_b = self._blocks(ind, b)
-            inter = popcount_words(all_sig & inc_b[:, :, None, :]) > 0
-            c = jnp.where(
-                inter[..., None], all_sig, all_sig | inc_b[:, :, None, :]
-            )
-            s = popcount_words(c | ind_b[:, :, None, :])  # sizeIfIncluded
+            if self.SCORE_CACHE:
+                # only the two due slots pay popcounts: the K resident
+                # slots' quantities ride in the caches, valid against the
+                # pre-commit aggregates by the end-of-tick invariant
+                # (deliver runs first; _commit re-fixes what it moves)
+                agg_b = self._blocks(agg, b)
+                inter2 = popcount_words(sig_new & inc_b[:, :, None, :]) > 0
+                c2 = jnp.where(
+                    inter2[..., None], sig_new, sig_new | inc_b[:, :, None, :]
+                )
+                s_new = popcount_words(c2 | ind_b[:, :, None, :])
+                all_s = jnp.concatenate(
+                    [proto["cand_s"].reshape(n, L - 1, K)[:, sl, :], s_new],
+                    axis=2,
+                )
+                all_card = jnp.concatenate(
+                    [
+                        proto["cand_card"].reshape(n, L - 1, K)[:, sl, :],
+                        popcount_words(sig_new),
+                    ],
+                    axis=2,
+                )
+                all_wind = jnp.concatenate(
+                    [
+                        proto["cand_wind"].reshape(n, L - 1, K)[:, sl, :],
+                        popcount_words(sig_new | ind_b[:, :, None, :]),
+                    ],
+                    axis=2,
+                )
+                all_aggi = jnp.concatenate(
+                    [
+                        proto["cand_aggi"].reshape(n, L - 1, K)[:, sl, :],
+                        (
+                            popcount_words(sig_new & agg_b[:, :, None, :]) > 0
+                        ).astype(jnp.int32),
+                    ],
+                    axis=2,
+                )
+                s = all_s
+            else:
+                inter = popcount_words(all_sig & inc_b[:, :, None, :]) > 0
+                c = jnp.where(
+                    inter[..., None], all_sig, all_sig | inc_b[:, :, None, :]
+                )
+                s = popcount_words(c | ind_b[:, :, None, :])  # sizeIfIncluded
             cur = popcount_words(inc_b)
             bl_all = self._getbit(bl, all_rel)
             keep = valid & (s > cur[:, :, None]) & (bl_all == 0)
@@ -477,7 +626,29 @@ class BatchedHandel(BitsetAggBase):
             cand_sig_updates[f"cand_sig{i}"] = sel_sig.reshape(
                 n, b.nl * K * b.w_pad
             )
+            if self.SCORE_CACHE:
+                s_pieces.append(jnp.take_along_axis(all_s, order, axis=2))
+                card_pieces.append(
+                    jnp.take_along_axis(all_card, order, axis=2)
+                )
+                wind_pieces.append(
+                    jnp.take_along_axis(all_wind, order, axis=2)
+                )
+                aggi_pieces.append(
+                    jnp.take_along_axis(all_aggi, order, axis=2)
+                )
 
+        cache_updates = {}
+        if self.SCORE_CACHE:
+            flat = lambda ps: jnp.concatenate(ps, axis=1).reshape(
+                n, (L - 1) * K
+            )
+            cache_updates = {
+                "cand_s": flat(s_pieces),
+                "cand_card": flat(card_pieces),
+                "cand_wind": flat(wind_pieces),
+                "cand_aggi": flat(aggi_pieces),
+            }
         state = state._replace(
             proto=dict(
                 proto,
@@ -486,6 +657,7 @@ class BatchedHandel(BitsetAggBase):
                 cand_rel=jnp.concatenate(rel_pieces, axis=1).reshape(n, (L - 1) * K),
                 msg_filtered=proto["msg_filtered"] + filtered,
                 **cand_sig_updates,
+                **cache_updates,
             )
         )
         return state
@@ -610,16 +782,26 @@ class BatchedHandel(BitsetAggBase):
 
             # curation (bestToVerify :592-612): drop blacklisted senders and
             # candidates that can no longer grow the aggregate
-            inter = popcount_words(c_sig & inc_b[:, :, None, :]) > 0
-            cc = jnp.where(inter[..., None], c_sig, c_sig | inc_b[:, :, None, :])
-            s = popcount_words(cc | ind_b[:, :, None, :])
+            if self.SCORE_CACHE:
+                # sizeIfIncluded / cardinalities come from the carried
+                # int32 caches (the viewed snapshot for scoring, the
+                # current leaf for entry identity) — no signature-word
+                # popcounts on this path
+                s = v["cand_s"].reshape(n, L - 1, K)[:, sl, :]
+                ccard_pieces.append(
+                    proto["cand_card"].reshape(n, L - 1, K)[:, sl, :]
+                )
+            else:
+                inter = popcount_words(c_sig & inc_b[:, :, None, :]) > 0
+                cc = jnp.where(inter[..., None], c_sig, c_sig | inc_b[:, :, None, :])
+                s = popcount_words(cc | ind_b[:, :, None, :])
+                cur_sig = self._sig_view(proto, i, K, prefix="cand_sig")
+                ccard_pieces.append(popcount_words(cur_sig))
             bl_bit = self._getbit(bl, c_rel)
             curated = valid & (s > popcount_words(inc_b)[:, :, None]) & (bl_bit == 0)
             # permanent removal, like replaceToVerifyAgg (:612-618) —
             # recorded as a condemn mask, applied by ENTRY IDENTITY below
             condemn_pieces.append(valid & ~curated)
-            cur_sig = self._sig_view(proto, i, K, prefix="cand_sig")
-            ccard_pieces.append(popcount_words(cur_sig))
 
             # windowIndex = min rank over the (pre-curation valid) queue
             window_index = jnp.min(
@@ -634,10 +816,15 @@ class BatchedHandel(BitsetAggBase):
 
             # score (:650-664)
             agg_card = popcount_words(agg_b)  # [N, nl]
-            sig_card = popcount_words(c_sig)
+            if self.SCORE_CACHE:
+                sig_card = v["cand_card"].reshape(n, L - 1, K)[:, sl, :]
+                agg_inter = v["cand_aggi"].reshape(n, L - 1, K)[:, sl, :] > 0
+                with_ind = v["cand_wind"].reshape(n, L - 1, K)[:, sl, :]
+            else:
+                sig_card = popcount_words(c_sig)
+                agg_inter = popcount_words(c_sig & agg_b[:, :, None, :]) > 0
+                with_ind = popcount_words(c_sig | ind_b[:, :, None, :])
             vcard_pieces.append(sig_card)
-            agg_inter = popcount_words(c_sig & agg_b[:, :, None, :]) > 0
-            with_ind = popcount_words(c_sig | ind_b[:, :, None, :])
             score = jnp.where(
                 agg_card[:, :, None] >= bs[None, :, None],
                 0,
@@ -892,9 +1079,15 @@ class BatchedHandel(BitsetAggBase):
         return state
 
     def _cand_keys(self):
-        return ("cand_rank", "cand_rel") + tuple(
+        keys = ("cand_rank", "cand_rel") + tuple(
             f"cand_sig{i}" for i in range(len(self.buckets))
         )
+        if self.SCORE_CACHE:
+            # the boundary view scores on end-of-previous-tick caches,
+            # which by the invariant equal a recompute from the viewed
+            # (cand_sig, inc, ind, agg) exactly
+            keys = keys + self.CACHE_LEAF_NAMES
+        return keys
 
     def all_done(self, state):
         live = ~state.down
@@ -909,10 +1102,23 @@ def make_handel(
     telemetry=None,  # telemetry.TelemetryConfig (None = uninstrumented)
     boundary_view: bool = True,  # False = pre-r5 selection (ablation only)
     annotate: bool = True,  # False = strip named-scope phase markers
+    score_cache: Optional[bool] = None,  # None = auto: on for TPU only
+    fuse_step: bool = False,  # True = engine's fused delivery+tick path
 ):
     """Host-side construction: build the node population with the oracle's
     RNG stream (positions, speed ratios, down set), bake into the engine."""
     params = params or HandelParameters()
+    if score_cache is None:
+        # The score cache trades bytes-accessed for carried int32 leaves —
+        # an HBM-bandwidth economy.  On TPU that is the budget's dominant
+        # cost (BUDGET.json: 1.93 GB/tick), so the cache defaults ON.  On
+        # CPU the masked delta-update scatters pay full width regardless
+        # of the due mask, and the 256x4 ablation prices the cache at a
+        # 5-10% LOSS — so it defaults OFF off-TPU.  Pass True/False to
+        # pin either way (bit-identical: tests/test_score_cache.py).
+        import jax
+
+        score_cache = jax.default_backend() == "tpu"
     n = params.node_count
     nb = registry_node_builders.get_by_name(params.node_builder_name)
     latency = registry_network_latencies.get_by_name(params.network_latency_name)
@@ -940,6 +1146,10 @@ def make_handel(
 
     proto = BatchedHandel(params)
     proto.BOUNDARY_VIEW = bool(boundary_view)
+    proto.SCORE_CACHE = bool(score_cache)
+    proto.DERIVED_CACHE_LEAVES = (
+        proto.CACHE_LEAF_NAMES if score_cache else ()
+    )
     # beat structure for the engine's real-branch gating: dissemination
     # fires at t with (t - (start_at + 1)) % period == 0
     proto.BEAT_PERIOD = params.dissemination_period_ms
@@ -967,7 +1177,7 @@ def make_handel(
     # scan minimal
     net = BatchedNetwork(
         proto, latency, n, capacity=capacity, wheel_rows=wheel_rows,
-        telemetry=telemetry, annotate=annotate,
+        telemetry=telemetry, annotate=annotate, fuse_step=fuse_step,
     )
     state = net.init_state(
         cols,
