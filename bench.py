@@ -810,7 +810,13 @@ def _headline(
 
 def _emit(rec: dict) -> None:
     """Print the BENCH record and (optionally) append it to the durable
-    JSONL run-record file."""
+    JSONL run-record file.  Every record carries the run-cache counter
+    snapshot (hit/miss/eviction/compile) so compile-amortization claims
+    — the serve scheduler's "fixed number of compiles" in particular —
+    are auditable from the bench archive alone."""
+    from wittgenstein_tpu.parallel.replica_shard import run_cache_info
+
+    rec.setdefault("run_cache", run_cache_info())
     print(json.dumps(rec))
     path = os.environ.get("WITT_BENCH_RUNRECORD")
     if path:

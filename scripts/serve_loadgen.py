@@ -1,0 +1,278 @@
+"""Serving load generator: concurrent tenants against the /w/jobs API.
+
+Boots the HTTP server in-process (`server.ws.serve(0)`), then fires N
+concurrent clients at it — a seed sweep, crash/recover fault plans,
+message-level fault plans (drop / inflate / silence), and a long
+chunked (preemptible) job that a late high-priority client overtakes.
+Every client asserts its OWN result: the returned state digest must be
+bitwise-identical to a singleton run of the same spec, so multi-tenancy
+is provably free of cross-tenant interference.
+
+The run then asserts the serving economics:
+
+  * fixed compiles — the whole workload (>= 8 clients, >= 3 scenario
+    families on one compatibility key, plus the chunked family) costs
+    at most 2 run-cache compiles (direct program + chunk program),
+    proven from the run cache's monotonic counters;
+  * batching actually happened — batch occupancy > 0 and fewer batches
+    than jobs;
+  * the SLO surface is live — queue depth, occupancy, latency/TTFR
+    quantiles, and the compile-cache hit ratio are all present in
+    /metrics.
+
+Writes an SLO report (JSONL + human-readable) to the output directory
+and exits nonzero on ANY failed job or violated assertion.  CI runs
+this as the tier-1 serving smoke step and uploads the report.
+
+Usage: python scripts/serve_loadgen.py [out_dir] [--clients N]
+       (defaults: ./serve_loadgen, 8 clients + 1 preemptor)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the dev environment's sitecustomize pins jax_platforms=axon at the
+    # config level; pin the config too (see tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+
+from wittgenstein_tpu.parallel.replica_shard import run_cache_info  # noqa: E402
+from wittgenstein_tpu.serve import BatchScheduler, quantile  # noqa: E402
+from wittgenstein_tpu.server.ws import WServer, serve  # noqa: E402
+
+SIM_MS = 100
+BASE = {"protocol": "PingPong", "params": {"node_ct": 64}, "simMs": SIM_MS}
+
+
+def scenarios(n_clients: int):
+    """>= 3 scenario families, all per-replica data on ONE compat key:
+    seed sweep, node-level fault plans, message-level fault plans."""
+    fams = [
+        lambda i: {**BASE, "seed": i},  # seeds
+        lambda i: {**BASE, "seed": i, "faults": [  # node faults
+            {"op": "crash", "nodes": [1 + i % 5, 7], "at": 10 + i,
+             "recover": 80},
+        ]},
+        lambda i: {**BASE, "seed": i, "faults": [  # message faults
+            {"op": "drop", "per_mille": 100 * (1 + i % 3)},
+            {"op": "inflate", "multiplier_pm": 1500, "add_ms": 2},
+        ]},
+    ]
+    return [
+        {"family": f"scenario-{i % len(fams)}", "spec": fams[i % len(fams)](i)}
+        for i in range(n_clients)
+    ]
+
+
+class Client(threading.Thread):
+    """One tenant: submit, long-poll the result, record latencies."""
+
+    def __init__(self, base_url: str, name: str, spec: dict):
+        super().__init__(name=name, daemon=True)
+        self.base_url = base_url
+        self.spec = spec
+        self.record = {"client": name, "spec": spec, "ok": False}
+
+    def _call(self, method, path, payload=None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=600) as r:
+                return r.status, json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    def run(self):
+        t0 = time.monotonic()
+        try:
+            status, out = self._call("POST", "/w/jobs", self.spec)
+            self.record["submitStatus"] = status
+            if status != 202:
+                self.record["error"] = f"submit -> {status}: {out}"
+                return
+            jid = out["id"]
+            status, res = self._call("GET", f"/w/jobs/{jid}/result?waitS=590")
+            self.record["resultStatus"] = status
+            self.record["latencyS"] = time.monotonic() - t0
+            if status != 200 or res.get("state") != "done":
+                self.record["error"] = f"result -> {status}: {res}"
+                return
+            self.record["jobId"] = jid
+            self.record["digest"] = res["result"]["digest"]
+            self.record["ok"] = True
+        except Exception as e:  # noqa: BLE001 — recorded, run fails
+            self.record["error"] = f"{type(e).__name__}: {e}"
+
+
+def parse_metrics(text: str) -> dict:
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            pass
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("out_dir", nargs="?",
+                    default=os.path.join(ROOT, "serve_loadgen"))
+    ap.add_argument("--clients", type=int, default=8,
+                    help="concurrent batch clients (>= 8 for the "
+                    "acceptance run; the chunked preemptor is extra)")
+    args = ap.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    ws = WServer(scheduler=BatchScheduler(max_batch_replicas=8))
+    httpd = serve(0, ws=ws)
+    base_url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    failures = []
+    cache0 = dict(run_cache_info())
+
+    # the chunked, preemptible tenant goes first so the direct clients
+    # (higher priority) demonstrably overtake it between slices
+    chunked_spec = {**BASE, "seed": 97, "simMs": 400, "chunkMs": 100,
+                    "priority": 0}
+    clients = [Client(base_url, "chunked-00", chunked_spec)]
+    for i, sc in enumerate(scenarios(args.clients)):
+        sc["spec"]["priority"] = 5
+        clients.append(Client(base_url, f"{sc['family']}-{i:02d}", sc["spec"]))
+
+    t_start = time.monotonic()
+    for c in clients:
+        c.start()
+        time.sleep(0.01)  # arrival jitter: exercise admission ordering
+    for c in clients:
+        c.join(600)
+    wall_s = time.monotonic() - t_start
+
+    for c in clients:
+        if not c.record["ok"]:
+            failures.append(f"{c.name}: {c.record.get('error')}")
+
+    # per-job correctness: batched result == singleton run, bitwise
+    if not failures:
+        for c in clients:
+            ref = ws.jobs.run_singleton(c.spec)
+            if c.record["digest"] != ref["digest"]:
+                failures.append(
+                    f"{c.name}: digest {c.record['digest']} != singleton "
+                    f"{ref['digest']} — cross-tenant interference"
+                )
+        distinct = {c.record.get("digest") for c in clients}
+        if len(distinct) != len(clients):
+            failures.append(
+                f"only {len(distinct)} distinct digests for {len(clients)} "
+                "distinct scenarios — results are not scenario-faithful"
+            )
+
+    # serving economics: <= 2 compiles for the whole workload
+    cache1 = dict(run_cache_info())
+    new_misses = cache1["misses"] - cache0["misses"]
+    new_compiles = cache1["compiles"] - cache0["compiles"]
+    if new_compiles > 2 or new_misses > 2:
+        failures.append(
+            f"workload cost {new_compiles} compiles / {new_misses} "
+            "run-cache misses (budget: 2 — direct + chunk program)"
+        )
+
+    m = ws.jobs.metrics
+    if m.batches_total == 0 or m.last_occupancy <= 0:
+        failures.append(
+            f"no batching observed (batches={m.batches_total}, "
+            f"occupancy={m.last_occupancy})"
+        )
+    if m.batches_total >= m.jobs_completed and args.clients >= 8:
+        failures.append(
+            f"{m.batches_total} batches for {m.jobs_completed} jobs — "
+            "jobs are not sharing dispatches"
+        )
+    if m.preemptions_total < 1 or m.resumes_total < 1:
+        failures.append(
+            f"the chunked tenant was never preempted/resumed "
+            f"(preemptions={m.preemptions_total}, resumes={m.resumes_total})"
+        )
+
+    # SLO exposition: the families CI alarms on must be present and sane
+    with urllib.request.urlopen(base_url + "/metrics", timeout=60) as r:
+        metrics_text = r.read().decode()
+    gauges = parse_metrics(metrics_text)
+    for family in (
+        "witt_serve_queue_depth",
+        "witt_serve_batch_occupancy",
+        'witt_serve_job_latency_seconds{quantile="0.5"}',
+        'witt_serve_job_latency_seconds{quantile="0.99"}',
+        'witt_serve_time_to_first_result_seconds{quantile="0.5"}',
+        "witt_serve_compile_cache_hit_ratio",
+        "witt_run_cache_misses_total",
+    ):
+        if family not in gauges:
+            failures.append(f"/metrics is missing {family}")
+    httpd.shutdown()
+    ws.jobs.stop()
+
+    lat = sorted(
+        c.record["latencyS"] for c in clients if "latencyS" in c.record
+    )
+    slo = {
+        "kind": "serve_loadgen",
+        "ok": not failures,
+        "clients": len(clients),
+        "scenarioFamilies": 3 + 1,  # 3 direct families + chunked
+        "wallS": round(wall_s, 3),
+        "jobsCompleted": m.jobs_completed,
+        "jobsFailed": m.jobs_failed,
+        "batches": m.batches_total,
+        "occupancy": round(m.last_occupancy, 4),
+        "preemptions": m.preemptions_total,
+        "resumes": m.resumes_total,
+        "latencyS": {
+            "p50": quantile(lat, 0.5),
+            "p99": quantile(lat, 0.99),
+        },
+        "runCacheDelta": {"misses": new_misses, "compiles": new_compiles},
+        "failures": failures,
+    }
+    with open(os.path.join(args.out_dir, "slo_report.jsonl"), "a") as f:
+        f.write(json.dumps(slo, sort_keys=True) + "\n")
+    with open(os.path.join(args.out_dir, "clients.jsonl"), "w") as f:
+        for c in clients:
+            f.write(json.dumps(c.record, sort_keys=True, default=str) + "\n")
+
+    print(json.dumps(slo, indent=2, sort_keys=True))
+    if failures:
+        print("serve_loadgen: FAILED", file=sys.stderr)
+        for msg in failures:
+            print(f"  - {msg}", file=sys.stderr)
+        return 1
+    print(
+        f"serve_loadgen: OK — {len(clients)} tenants, "
+        f"{m.batches_total} batches, {new_compiles} compiles, "
+        f"p99 {slo['latencyS']['p99']:.2f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,6 @@
 """simlint command line: `python -m wittgenstein_tpu.analysis [opts]`.
 
-Runs up to six passes and prints findings as `path:line: RULE [sev] msg`
+Runs up to seven passes and prints findings as `path:line: RULE [sev] msg`
 (or JSONL with --format json):
 
   1. AST lint over every wittgenstein_tpu/*.py  (SL1xx/SL2xx)
@@ -9,9 +9,10 @@ Runs up to six passes and prints findings as `path:line: RULE [sev] msg`
   4. beat RNG audit                             (SL405)
   5. checkpoint completeness                    (SL501)
   6. phase-annotation presence + neutrality     (SL601)
+  7. serve scheduler batching contract          (SL801)
 
 Exit status: 0 when clean; 1 when any ERROR finding (or, with --strict,
-any finding at all) survives suppression; 2 on usage errors.  Passes 3-6
+any finding at all) survives suppression; 2 on usage errors.  Passes 3-7
 build every registered protocol and trace real kernels, so they take tens
 of seconds — `--skip-contracts` runs just the fast text-level passes.
 """
@@ -99,6 +100,9 @@ def run(root: str, skip_contracts: bool = False,
         findings += audit_all(root=root, names=protocols)
         findings += check_checkpoints(root=root, names=protocols)
         findings += check_annotations(root=root, names=protocols)
+        from .serve_check import check_serve_scheduler
+
+        findings += check_serve_scheduler(root=root, names=protocols)
     return findings
 
 

@@ -79,6 +79,11 @@ RULES: Dict[str, str] = {
     "stale after concrete steps (carried cache differs bitwise from "
     "recompute_caches()), missing from proto_init, or uncovered by the "
     "recompute oracle",
+    # -- serving scheduler contract -------------------------------------------
+    "SL801": "serve batching contract: jobs packed into one batch must "
+    "share the exact static-config digest and row leaf signature, and "
+    "re-dispatching an identical workload must be a pure run-cache hit "
+    "(no recompile-per-batch regression)",
 }
 
 

@@ -1,0 +1,172 @@
+"""simlint SL801: the serving scheduler's batching contract.
+
+The serve layer's throughput story rests on one invariant: every job
+packed into a batch shares the EXACT static-config digest (protocol +
+traced params + horizon + chunk schedule + template leaf signature), so
+a steady workload is served from a fixed number of compiled programs.
+A per-job knob silently leaking into the trace — a params field that
+should split the compatibility key but doesn't, or a rebuilt engine
+object defeating the run cache's id()-keyed entries — turns "one
+compile per family" into "one compile per job" without any test
+failing on correctness.  This pass pins the contract dynamically:
+
+  1. **digest purity** — plan a mixed pending set (seed sweep, fault
+     plan, a traced-param variant); every planned batch's jobs must
+     resolve to ONE full family digest, and the traced variant must
+     land in a DIFFERENT batch with a different digest;
+  2. **row uniformity** — the packed rows of a planned batch must share
+     one leaf signature (shapes/dtypes), or the stacked program would
+     differ from the family's;
+  3. **compile amortization** — dispatching a second identical batch
+     must be a pure run-cache HIT: any new miss is the
+     recompile-per-batch regression this rule exists to catch.
+
+Like the other dynamic passes this builds a real (tiny) engine and runs
+real dispatches on CPU.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from typing import List, Optional
+
+from .findings import Finding, Severity
+
+
+def _anchor(root: str):
+    """(repo-relative path, line) of the BatchScheduler definition —
+    every SL801 finding points at the scheduler."""
+    from ..serve.scheduler import BatchScheduler
+
+    path = inspect.getsourcefile(BatchScheduler) or "wittgenstein_tpu/serve/scheduler.py"
+    try:
+        line = inspect.getsourcelines(BatchScheduler)[1]
+    except OSError:
+        line = 1
+    try:
+        rel = os.path.relpath(path, root)
+        if not rel.startswith(".."):
+            path = rel
+    except ValueError:
+        pass
+    return path, line
+
+
+def _finding(path: str, line: int, msg: str) -> Finding:
+    return Finding("SL801", path, line, msg, Severity.ERROR)
+
+
+def check_serve_scheduler(
+    root: str = ".", names: Optional[List[str]] = None
+) -> List[Finding]:
+    """SL801 over a synthetic mixed workload (PingPong fixture)."""
+    if names and "PingPong" not in names:
+        return []
+    from ..parallel.replica_shard import run_cache_info
+    from ..serve.jobs import JobState
+    from ..serve.scheduler import BatchScheduler, _leaf_signature
+
+    path, line = _anchor(root)
+    findings: List[Finding] = []
+
+    sched = BatchScheduler(auto_start=False, max_batch_replicas=4)
+    base = {"protocol": "PingPong", "params": {"node_ct": 32}, "simMs": 60}
+    specs = [
+        {**base, "seed": 0},
+        {**base, "seed": 1},
+        {**base, "seed": 1,
+         "faults": [{"op": "crash", "nodes": [1], "at": 10}]},
+        # traced param change: MUST split the batch
+        {"protocol": "PingPong", "params": {"node_ct": 48}, "simMs": 60,
+         "seed": 0},
+    ]
+    jobs = [sched.submit(s) for s in specs]
+    by_id = {j.id: j for j in jobs}
+    split_job = jobs[-1]
+
+    plans = sched.plan_batches()
+
+    # 1. digest purity within every planned batch, split across batches
+    for plan in plans:
+        digests = set()
+        sigs = set()
+        for jid in plan["jobs"]:
+            job = by_id[jid]
+            fam = sched.family_for(job.spec)
+            digests.add(fam.digest)
+            # 2. row uniformity: the packed row's leaf signature must
+            # match the family template's
+            sigs.add(_leaf_signature(sched._row(fam, job.spec)))
+        if len(digests) > 1:
+            findings.append(_finding(
+                path, line,
+                f"batch {plan['jobs']} mixes static-config digests "
+                f"{sorted(digests)} — jobs packed together must share "
+                "one compiled program",
+            ))
+        if len(sigs) > 1:
+            findings.append(_finding(
+                path, line,
+                f"batch {plan['jobs']} packs rows with differing leaf "
+                "signatures — the stacked state would not match the "
+                "family's compiled program",
+            ))
+        if (
+            split_job.id in plan["jobs"]
+            and len(plan["jobs"]) > 1
+        ):
+            findings.append(_finding(
+                path, line,
+                "a traced-param variant (node_ct=48) was planned into "
+                "the same batch as node_ct=32 jobs — the compatibility "
+                "key ignores a trace-shaping param",
+            ))
+    fam_a = sched.family_for(jobs[0].spec)
+    fam_b = sched.family_for(split_job.spec)
+    if fam_a.digest == fam_b.digest:
+        findings.append(_finding(
+            path, line,
+            "node_ct=32 and node_ct=48 resolved to the same family "
+            "digest — traced params are not part of the compatibility "
+            "key",
+        ))
+    if findings:
+        return findings
+
+    # 3. compile amortization: run everything, then an identical second
+    # wave — the second wave must be pure cache hits
+    while sched.drain_once():
+        pass
+    for j in jobs:
+        if j.state is not JobState.DONE:
+            findings.append(_finding(
+                path, line,
+                f"fixture job {j.id} finished {j.state.value} "
+                f"({j.error}) — the contract run itself failed",
+            ))
+            return findings
+    before = run_cache_info()
+    wave2 = [sched.submit(s) for s in specs]
+    while sched.drain_once():
+        pass
+    after = run_cache_info()
+    for j in wave2:
+        if j.state is not JobState.DONE:
+            findings.append(_finding(
+                path, line,
+                f"second-wave job {j.id} finished {j.state.value} "
+                f"({j.error})",
+            ))
+            return findings
+    new_misses = after["misses"] - before["misses"]
+    new_compiles = after["compiles"] - before["compiles"]
+    if new_misses or new_compiles:
+        findings.append(_finding(
+            path, line,
+            f"re-dispatching an identical workload cost {new_misses} "
+            f"run-cache miss(es) / {new_compiles} compile(s) — the "
+            "scheduler is recompiling per batch instead of serving "
+            "steady workloads from cached programs",
+        ))
+    return findings

@@ -1,0 +1,37 @@
+"""Multi-tenant serving layer: jobs -> compatibility families ->
+replica-axis batches -> one compiled program per family.
+
+See docs/serving.md for the job model, the compatibility-key
+discipline, batching/preemption semantics, and the SLO metric catalog.
+"""
+
+from .jobs import (
+    Job,
+    JobQueue,
+    JobSpec,
+    JobState,
+    QueueFullError,
+    SERVE_PROTOCOLS,
+    UnknownJobError,
+    plan_from_spec,
+    serve_protocol,
+)
+from .metrics import ServeMetrics, quantile
+from .scheduler import BatchScheduler, ScenarioFamily, state_digest
+
+__all__ = [
+    "BatchScheduler",
+    "Job",
+    "JobQueue",
+    "JobSpec",
+    "JobState",
+    "QueueFullError",
+    "ScenarioFamily",
+    "ServeMetrics",
+    "SERVE_PROTOCOLS",
+    "UnknownJobError",
+    "plan_from_spec",
+    "quantile",
+    "serve_protocol",
+    "state_digest",
+]

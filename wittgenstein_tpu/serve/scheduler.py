@@ -1,0 +1,647 @@
+"""The batching scheduler: compatible jobs share one compiled program.
+
+Grouping discipline
+-------------------
+Two jobs may ride the same ``run_ms_batched`` dispatch iff they resolve
+to the same **scenario family**: protocol name + every traced param
+(anything not in the serve registry's ``state_only`` set) + simulation
+horizon + execution mode (direct vs chunk schedule).  That pre-key is
+computed at admission from the spec alone; when the family is first
+built, the full static digest is extended with
+``runtime.supervisor.stable_run_key`` over the engine + template leaf
+signature — the same digest discipline the durable executor stamps into
+checkpoints — so "compatible" is defined by what actually shapes the
+trace, not by what the client claimed.  Everything else a job carries —
+seed, FaultPlan, state-only params — is per-replica DATA.
+
+Fixed-compile guarantee
+-----------------------
+Every dispatch is padded to a fixed replica capacity
+(``max_batch_replicas``; padding rows are template copies whose results
+are discarded), so every batch of a family presents the identical input
+leaf signature to the run cache (parallel.replica_shard): ONE compile
+per (family, horizon) however the workload arrives.  The run cache's
+monotonic hit/miss/compile counters make the claim measurable — the
+loadgen asserts it.
+
+Families hold ONE engine object each on purpose: ``net.cache_key()``
+includes ``id(protocol)``/``id(latency)``, so rebuilding the engine per
+job would defeat the cache even with identical params (simlint SL801
+pins this contract).
+
+Preemption
+----------
+A job with ``chunkMs`` set runs through ``runtime.Supervisor`` in
+slices of ``slice_chunks`` device calls, checkpointing every chunk via
+``engine/checkpoint.CheckpointManager``.  Between slices the worker
+checks the queue: queued work with strictly higher priority parks the
+batch (its checkpoint is the park ticket) and runs first; the parked
+batch later resumes from the checkpoint, bit-identical to an
+uninterrupted run (the supervisor's replay contract).  The chunk
+function is routed through the SAME run cache, so the chunked mode
+costs one extra compile per family, not one per slice.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .jobs import (
+    Job,
+    JobQueue,
+    JobSpec,
+    JobState,
+    QueueFullError,
+    serve_protocol,
+)
+from .metrics import ServeMetrics
+
+
+def _leaf_signature(state) -> tuple:
+    """(path, shape, dtype) per leaf — rows packed together must agree
+    exactly or the stacked program would differ from the family's."""
+    import jax
+
+    sig = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        sig.append(
+            (
+                str(path),
+                tuple(getattr(leaf, "shape", ())),
+                str(getattr(leaf, "dtype", type(leaf).__name__)),
+            )
+        )
+    return tuple(sig)
+
+
+def state_digest(state) -> str:
+    """Bitwise identity of a state pytree (side-cars included): blake2b
+    over every leaf's path, dtype, shape, and raw bytes.  Two runs are
+    'the same result' iff these match — the multi-tenant correctness
+    contract (batched row == singleton run) is checked on this."""
+    import jax
+    import numpy as np
+
+    h = hashlib.blake2b(digest_size=16)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        arr = np.asarray(leaf)
+        h.update(str(path).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+class ScenarioFamily:
+    """One compatibility class: a single engine object + per-params
+    single-replica templates, all sharing one traced program."""
+
+    def __init__(self, key, digest, net, entry, tele_cfg, sim_ms, chunk_ms,
+                 base_params_key, base_template):
+        self.key = key  # admission-time pre-key
+        self.digest = digest  # full static digest (stable_run_key suffix)
+        self.net = net
+        self.entry = entry
+        self.tele_cfg = tele_cfg
+        self.sim_ms = sim_ms
+        self.chunk_ms = chunk_ms
+        self.templates: Dict[str, object] = {base_params_key: base_template}
+        self.signature = _leaf_signature(base_template)
+
+
+class _ParkedBatch:
+    """A chunked batch between slices: the Supervisor (whose checkpoint
+    directory is the resume ticket) plus the jobs riding it."""
+
+    def __init__(self, batch_id, family, jobs, supervisor, ckpt_dir,
+                 priority, capacity):
+        self.batch_id = batch_id
+        self.family = family
+        self.jobs = jobs
+        self.supervisor = supervisor
+        self.ckpt_dir = ckpt_dir
+        self.priority = priority
+        self.capacity = capacity
+        self.preempted = False
+        self.started = time.monotonic()
+
+
+class BatchScheduler:
+    """Queue consumer: groups, packs, dispatches, streams progress.
+
+    One worker thread serializes all device work (the engine is
+    replica-parallel, not request-parallel); HTTP handlers only touch
+    the queue and job records.  ``auto_start=False`` leaves the worker
+    off so tests can drive ``drain_once()`` deterministically."""
+
+    def __init__(
+        self,
+        queue: Optional[JobQueue] = None,
+        metrics: Optional[ServeMetrics] = None,
+        *,
+        max_batch_replicas: int = 8,
+        slice_chunks: int = 2,
+        telemetry_snapshots: int = 32,
+        checkpoint_root: Optional[str] = None,
+        auto_start: bool = True,
+    ):
+        if max_batch_replicas < 1:
+            raise ValueError(
+                f"max_batch_replicas must be >= 1, got {max_batch_replicas}"
+            )
+        self.queue = queue or JobQueue()
+        self.metrics = metrics or ServeMetrics()
+        self.max_batch_replicas = max_batch_replicas
+        self.slice_chunks = max(1, slice_chunks)
+        self.telemetry_snapshots = telemetry_snapshots
+        self.checkpoint_root = checkpoint_root or os.path.join(
+            tempfile.gettempdir(), f"witt_serve_ckpt_{os.getpid()}"
+        )
+        self.auto_start = auto_start
+        self._families: Dict[str, ScenarioFamily] = {}
+        self._fam_lock = threading.Lock()
+        self._parked: List[_ParkedBatch] = []
+        self._batch_seq = 0
+        self._ema_batch_s = 1.0
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- admission -----------------------------------------------------
+
+    def pre_key(self, spec: JobSpec) -> str:
+        """Compatibility pre-key from the spec alone (no engine build):
+        protocol + traced params + horizon + chunk schedule + telemetry
+        geometry.  Jobs sharing it are CANDIDATES for one batch; the
+        family build extends it with the template leaf signature."""
+        entry = serve_protocol(spec.protocol)
+        traced = {
+            k: spec.params[k]
+            for k in sorted(spec.params)
+            if k not in entry.state_only
+        }
+        payload = json.dumps(
+            {
+                "protocol": spec.protocol,
+                "traced": traced,
+                "sim_ms": spec.sim_ms,
+                "chunk_ms": spec.chunk_ms,
+                "snapshots": self.telemetry_snapshots,
+            },
+            sort_keys=True,
+            default=str,
+        )
+        return "fam-" + hashlib.blake2b(
+            payload.encode(), digest_size=8
+        ).hexdigest()
+
+    def retry_after_s(self) -> int:
+        """Seconds until queued work likely drains one batch slot, from
+        the EMA batch wall time (RFC 9110: >= 1)."""
+        batches_ahead = self.queue.depth() // self.max_batch_replicas + 1
+        return max(1, int(batches_ahead * self._ema_batch_s + 0.5))
+
+    def submit(self, spec_dict: dict) -> Job:
+        """Parse, validate, and enqueue one job (raises ValueError /
+        KeyError on a malformed spec, QueueFullError on backpressure)."""
+        spec = JobSpec.from_dict(spec_dict)
+        job = Job(spec=spec, compat=self.pre_key(spec),
+                  priority=spec.priority)
+        self.queue.submit(job, retry_after_s=self.retry_after_s())
+        self.metrics.observe_submit()
+        if self.auto_start:
+            self.start()
+        return job
+
+    def submit_legacy(self, thunk, priority: int = 0) -> Job:
+        """Queue an opaque host-side thunk (the rerouted /w/sweep): it
+        occupies one worker turn and is never packed with batch jobs."""
+        job = Job(spec=None, compat="", kind="legacy", thunk=thunk,
+                  priority=priority)
+        job.compat = f"legacy-{job.id}"
+        self.queue.submit(job, retry_after_s=self.retry_after_s())
+        self.metrics.observe_submit()
+        if self.auto_start:
+            self.start()
+        return job
+
+    def cancel(self, job_id: str) -> Job:
+        job, cancelled_now = self.queue.cancel(job_id)
+        if cancelled_now:
+            self.metrics.observe_job(job)
+            self.queue.retire(job)
+        return job
+
+    # -- families ------------------------------------------------------
+
+    @staticmethod
+    def _params_key(params: dict) -> str:
+        return json.dumps(params, sort_keys=True, default=str)
+
+    def family_for(self, spec: JobSpec) -> ScenarioFamily:
+        key = self.pre_key(spec)
+        with self._fam_lock:
+            fam = self._families.get(key)
+            if fam is not None:
+                return fam
+            from ..runtime.supervisor import stable_run_key
+            from ..telemetry import TelemetryConfig
+
+            snaps = self.telemetry_snapshots
+            tele_cfg = TelemetryConfig(
+                snapshots=snaps,
+                snapshot_every_ms=max(1, spec.sim_ms // max(1, snaps)),
+            )
+            entry = serve_protocol(spec.protocol)
+            net, state = entry.build(spec.params, tele_cfg)
+            # faults are ALWAYS armed: a fault-free job is the neutral
+            # schedule (bit-identical by the SL406 contract), so one
+            # program serves faulted and clean rows alike
+            net, state = net.with_faults(state)
+            n_chunks = (
+                spec.sim_ms // spec.chunk_ms if spec.chunk_ms else 1
+            )
+            digest = key + "/" + stable_run_key(
+                net, state, n_chunks, spec.chunk_ms or spec.sim_ms
+            )
+            fam = ScenarioFamily(
+                key, digest, net, entry, tele_cfg, spec.sim_ms,
+                spec.chunk_ms, self._params_key(spec.params), state,
+            )
+            self._families[key] = fam
+            return fam
+
+    def _template_for(self, fam: ScenarioFamily, spec: JobSpec):
+        pk = self._params_key(spec.params)
+        st = fam.templates.get(pk)
+        if st is not None:
+            return st
+        # params differ only in state-only fields (same pre-key): build
+        # the layout with a throwaway engine, arm side-cars through the
+        # FAMILY net so the signature discipline is identical, and keep
+        # only the state
+        _, st = fam.entry.build(spec.params, fam.tele_cfg)
+        _, st = fam.net.with_faults(st)
+        if _leaf_signature(st) != fam.signature:
+            raise ValueError(
+                f"params {spec.params} change the traced program despite "
+                f"matching family {fam.key} — state-only contract "
+                "violation (simlint SL801)"
+            )
+        fam.templates[pk] = st
+        return st
+
+    def _row(self, fam: ScenarioFamily, spec: JobSpec):
+        st = self._template_for(fam, spec)
+        # seed is per-replica data; `*0 +` keeps the leaf dtype exact
+        return st._replace(seed=st.seed * 0 + spec.seed)
+
+    def _pack(self, fam: ScenarioFamily, jobs: List[Job]):
+        """Stack job rows + padding to the fixed replica capacity and
+        attach the per-row fault schedules.  The padding rows are the
+        base template (results discarded): every batch of a family has
+        the identical leaf signature -> one compile, ever."""
+        from ..engine import stack_states
+        from ..faults.plan import lower_plans
+
+        base = next(iter(fam.templates.values()))
+        rows = [self._row(fam, j.spec) for j in jobs]
+        rows += [base] * (self.max_batch_replicas - len(rows))
+        stacked = stack_states(rows)
+        plans = [j.spec.plan for j in jobs]
+        plans += [None] * (self.max_batch_replicas - len(plans))
+        fs = lower_plans(
+            plans, fam.net.n_nodes, fam.net.protocol.n_msg_types()
+        )
+        return stacked._replace(faults=fs)
+
+    # -- results -------------------------------------------------------
+
+    def _row_result(self, fam: ScenarioFamily, row) -> dict:
+        import numpy as np
+
+        from ..telemetry.export import counters, progress_series
+
+        return {
+            "digest": state_digest(row),
+            "time": int(np.asarray(row.time)),
+            "counters": counters(fam.net, row),
+            "progress": progress_series(row),
+        }
+
+    def run_singleton(self, spec_dict: dict) -> dict:
+        """Reference result for one spec: a 1-row stack through the
+        engine directly (no packing, no run cache).  The multi-tenant
+        contract is that every batched job's result digest equals this
+        — rows of a vmapped run are lane-independent.  A chunked spec
+        replays the SAME chunk schedule: the sim state is schedule-
+        independent, but the telemetry loop census (jumps cannot cross
+        a chunk boundary) is part of the digested side-car."""
+        import jax
+
+        from ..engine import stack_states
+        from ..faults.plan import lower_plans
+
+        spec = JobSpec.from_dict(spec_dict)
+        fam = self.family_for(spec)
+        row = self._row(fam, spec)
+        stacked = stack_states([row])
+        fs = lower_plans(
+            [spec.plan], fam.net.n_nodes, fam.net.protocol.n_msg_types()
+        )
+        out = stacked._replace(faults=fs)
+        step = spec.chunk_ms or spec.sim_ms
+        for _ in range(spec.sim_ms // step):
+            out = fam.net.run_ms_batched(out, step)
+        single = jax.tree_util.tree_map(lambda a: a[0], out)
+        return self._row_result(fam, single)
+
+    # -- planning (also the simlint SL801 surface) ---------------------
+
+    def plan_batches(self) -> List[dict]:
+        """Group the pending queue into dispatch plans WITHOUT removing
+        or running anything: highest-priority-first, FIFO within a
+        family, capped at the replica capacity.  Every plan's jobs share
+        one compat key by construction — the property simlint's
+        scheduler-contract pass verifies against the full static
+        digests."""
+        remaining = sorted(
+            self.queue.pending_snapshot(),
+            key=lambda j: (-j.priority, j.seq),
+        )
+        plans = []
+        while remaining:
+            head = remaining[0]
+            same = [j for j in remaining if j.compat == head.compat]
+            take = same[: self.max_batch_replicas]
+            taken = set(id(j) for j in take)
+            remaining = [j for j in remaining if id(j) not in taken]
+            plans.append(
+                {
+                    "compat": head.compat,
+                    "priority": head.priority,
+                    "jobs": [j.id for j in take],
+                    "kind": head.kind,
+                }
+            )
+        return plans
+
+    # -- dispatch ------------------------------------------------------
+
+    def drain_once(self) -> bool:
+        """One scheduling decision: resume the best parked batch or
+        dispatch the best pending group.  Returns False when idle.
+        Deterministic entry point for tests; the worker loop just calls
+        this."""
+        parked = max(
+            self._parked, key=lambda b: (b.priority, -b.started),
+            default=None,
+        )
+        best = self.queue.best_pending()
+        if parked is not None and (
+            best is None or best.priority <= parked.priority
+        ):
+            return self._continue_parked(parked)
+        if best is None:
+            return False
+        if parked is not None and best.priority > parked.priority:
+            if not parked.preempted:
+                parked.preempted = True
+                self.metrics.observe_preemption()
+        jobs = self.queue.take_batch(
+            best.compat,
+            1 if best.kind == "legacy" else self.max_batch_replicas,
+        )
+        if not jobs:
+            return False
+        if best.kind == "legacy":
+            self._run_legacy(jobs[0])
+            return True
+        self._dispatch(jobs)
+        return True
+
+    def _finish_job(self, job: Job, state: JobState, **kw) -> None:
+        job.finish(state, **kw)
+        self.metrics.observe_job(job)
+        self.queue.retire(job)
+
+    def _run_legacy(self, job: Job) -> None:
+        job.state = JobState.RUNNING
+        job.started_at = time.monotonic()
+        if job.cancel_requested:
+            self._finish_job(job, JobState.CANCELLED)
+            return
+        try:
+            result = job.thunk()
+        except BaseException as e:  # noqa: BLE001 — surfaced to waiter
+            self._finish_job(
+                job, JobState.FAILED,
+                error=f"{type(e).__name__}: {e}", exc=e,
+            )
+            return
+        self._finish_job(job, JobState.DONE, result=result)
+
+    def _dispatch(self, jobs: List[Job]) -> None:
+        live = []
+        for j in jobs:
+            if j.cancel_requested:
+                self._finish_job(j, JobState.CANCELLED)
+            else:
+                live.append(j)
+        if not live:
+            return
+        # scheduler contract (simlint SL801): one batch, one digest
+        compat = {j.compat for j in live}
+        if len(compat) != 1:
+            raise RuntimeError(
+                f"batch mixes compatibility keys {sorted(compat)}"
+            )
+        try:
+            fam = self.family_for(live[0].spec)
+            stacked = self._pack(fam, live)
+        except BaseException as e:  # noqa: BLE001 — build/pack failure
+            for j in live:
+                self._finish_job(
+                    j, JobState.FAILED,
+                    error=f"{type(e).__name__}: {e}", exc=e,
+                )
+            return
+        self._batch_seq += 1
+        batch_id = f"batch-{self._batch_seq:05d}"
+        now = time.monotonic()
+        for j in live:
+            j.state = JobState.RUNNING
+            j.started_at = now
+            j.batch_id = batch_id
+        if fam.chunk_ms:
+            self._start_chunked(batch_id, fam, live, stacked)
+        else:
+            self._dispatch_direct(batch_id, fam, live, stacked)
+
+    def _dispatch_direct(self, batch_id, fam, jobs, stacked) -> None:
+        from ..parallel.replica_shard import sharded_run_stats
+
+        t0 = time.monotonic()
+        try:
+            out, _stats = sharded_run_stats(fam.net, stacked, fam.sim_ms)
+            self._finalize(fam, jobs, out)
+        except BaseException as e:  # noqa: BLE001 — device failure
+            for j in jobs:
+                self._finish_job(
+                    j, JobState.FAILED,
+                    error=f"{type(e).__name__}: {e}", exc=e,
+                )
+            return
+        finally:
+            dt = time.monotonic() - t0
+            self._ema_batch_s = 0.5 * self._ema_batch_s + 0.5 * dt
+            self.metrics.observe_batch(
+                len(jobs), self.max_batch_replicas, dt
+            )
+
+    def _start_chunked(self, batch_id, fam, jobs, stacked) -> None:
+        from ..parallel.replica_shard import _run_and_reduce
+        from ..runtime.supervisor import Supervisor, stable_run_key
+
+        n_chunks = fam.sim_ms // fam.chunk_ms
+        ckpt_dir = os.path.join(self.checkpoint_root, batch_id)
+        # the chunk function goes through the run cache too: chunked
+        # mode costs ONE extra compile per family, not one per slice
+        cached = _run_and_reduce(fam.net, fam.chunk_ms)
+        sup = Supervisor(
+            lambda s: cached(s)[0],
+            stacked,
+            n_chunks=n_chunks,
+            chunk_ms=fam.chunk_ms,
+            checkpoint_dir=ckpt_dir,
+            checkpoint_every=1,
+            run_key=stable_run_key(fam.net, stacked, n_chunks, fam.chunk_ms),
+            max_chunks_this_run=self.slice_chunks,
+        )
+        parked = _ParkedBatch(
+            batch_id, fam, jobs, sup, ckpt_dir,
+            max(j.priority for j in jobs), self.max_batch_replicas,
+        )
+        self._parked.append(parked)
+        self._continue_parked(parked)
+
+    def _continue_parked(self, parked: _ParkedBatch) -> bool:
+        if parked.preempted:
+            parked.preempted = False
+            self.metrics.observe_resume()
+        if all(j.cancel_requested for j in parked.jobs):
+            for j in parked.jobs:
+                self._finish_job(j, JobState.CANCELLED)
+            self._drop_parked(parked)
+            return True
+        t0 = time.monotonic()
+        try:
+            report = parked.supervisor.run()
+        except BaseException as e:  # noqa: BLE001 — supervised failure
+            for j in parked.jobs:
+                self._finish_job(
+                    j, JobState.FAILED,
+                    error=f"{type(e).__name__}: {e}", exc=e,
+                )
+            self._drop_parked(parked)
+            return True
+        dt = time.monotonic() - t0
+        self._ema_batch_s = 0.5 * self._ema_batch_s + 0.5 * dt
+        self.metrics.observe_batch(len(parked.jobs), parked.capacity, dt)
+        self._stream_progress(parked, report.state)
+        if report.ok:
+            self._finalize(parked.family, parked.jobs, report.state)
+            self._drop_parked(parked)
+        # ok=False: a controlled partial stop — the batch stays parked
+        # (checkpoint on disk) and the next drain_once decides whether
+        # it continues or yields to higher-priority work
+        return True
+
+    def _stream_progress(self, parked: _ParkedBatch, stacked) -> None:
+        from ..telemetry.export import progress_series
+
+        for i, job in enumerate(parked.jobs):
+            if job.state is not JobState.RUNNING:
+                continue
+            series = progress_series(stacked, replica=i)
+            if series:
+                job.progress = series
+                self.metrics.observe_ttfr(job)
+
+    def _drop_parked(self, parked: _ParkedBatch) -> None:
+        if parked in self._parked:
+            self._parked.remove(parked)
+        shutil.rmtree(parked.ckpt_dir, ignore_errors=True)
+
+    def _finalize(self, fam: ScenarioFamily, jobs: List[Job], out) -> None:
+        import jax
+
+        for i, job in enumerate(jobs):
+            if job.cancel_requested:
+                self._finish_job(job, JobState.CANCELLED)
+                continue
+            row = jax.tree_util.tree_map(lambda a, i=i: a[i], out)
+            result = self._row_result(fam, row)
+            job.progress = result["progress"]
+            self._finish_job(job, JobState.DONE, result=result)
+
+    # -- worker --------------------------------------------------------
+
+    def start(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._stop.clear()
+        self._worker = threading.Thread(
+            target=self._loop, daemon=True, name="witt-serve-worker"
+        )
+        self._worker.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self.queue.notify()
+        if self._worker is not None:
+            self._worker.join(timeout)
+            self._worker = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if not self.drain_once():
+                    self.queue.wait_for_work(timeout=0.2)
+            except Exception:  # noqa: BLE001 — worker must not die
+                # per-job failures are reported on the jobs themselves;
+                # anything reaching here is a scheduler bug — park for a
+                # beat instead of spinning
+                time.sleep(0.1)
+
+    def busy(self) -> bool:
+        return bool(self._parked) or self.queue.depth() > 0
+
+    def wait_idle(self, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self.busy():
+                return True
+            time.sleep(0.02)
+        return not self.busy()
+
+    # -- exposition ----------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "queueDepth": self.queue.depth(),
+            "queueCapacity": self.queue.max_depth,
+            "parkedBatches": len(self._parked),
+            "families": len(self._families),
+            "maxBatchReplicas": self.max_batch_replicas,
+            "retryAfterS": self.retry_after_s(),
+        }
+
+    def add_prometheus(self, p) -> None:
+        self.metrics.add_prometheus(p, self.queue)

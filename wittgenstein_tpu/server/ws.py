@@ -23,9 +23,21 @@ including its start/stop asymmetry — /w/nodes/{id}/start vs
   POST /w/sweep                          batch sweep: {"protocol", "params",
                                          "runs", "maxTime", "stats"} ->
                                          RunMultipleTimes aggregates
+                                         (executes via the job queue; the
+                                         handler blocks for the legacy
+                                         response shape)
+  POST   /w/jobs                         submit a batched job (202; 429 +
+                                         Retry-After when the queue is full)
+  GET    /w/jobs                         job list + scheduler status
+  GET    /w/jobs/{id}                    job status + streamed progress
+  GET    /w/jobs/{id}/result             result (optional ?waitS= blocking)
+  DELETE /w/jobs/{id}                    cancel (queued: immediate; running:
+                                         dropped at the batch boundary)
 
 The simulation core is single-threaded by design (Network.java:10), so all
-handlers serialize on one lock.
+handlers serialize on one lock.  The /w/jobs surface is the multi-tenant
+path (serve/): handlers only touch the queue and job records; one worker
+thread packs compatible jobs onto the replica axis — see docs/serving.md.
 """
 
 from __future__ import annotations
@@ -38,6 +50,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Optional, Tuple
 
+from ..serve import BatchScheduler, JobState, QueueFullError, UnknownJobError
 from .server import Server
 
 _STATIC_DIR = Path(__file__).parent / "static"
@@ -93,8 +106,12 @@ class WServer:
     #: sim-ms advanced per lock hold; interrupt/busy checks run between
     RUN_SLICE_MS = 50
 
-    def __init__(self):
+    def __init__(self, scheduler: Optional[BatchScheduler] = None):
         self.server = Server()
+        # multi-tenant job path (serve/): construction is light — the
+        # engine families build lazily on first dispatch, and the worker
+        # thread starts on first submit
+        self.jobs = scheduler or BatchScheduler()
         self.lock = threading.Lock()
         # serializes runMs only (non-blocking acquire -> 503, not queue)
         self.run_lock = threading.Lock()
@@ -226,8 +243,106 @@ class WServer:
 
     @route("GET", r"/metrics")
     def metrics(self, body):
-        # Prometheus convention: bare /metrics, text format, no /w prefix
-        return RawResponse(self.server.metrics_text())
+        # Prometheus convention: bare /metrics, text format, no /w prefix.
+        # One exposition: the oracle-side families plus the serving
+        # layer's witt_serve_* SLO families (queue depth, occupancy,
+        # latency quantiles, compile-cache hit ratio)
+        from ..telemetry.export import PromText
+
+        p = PromText()
+        self.jobs.add_prometheus(p)
+        return RawResponse(self.server.metrics_text() + p.render())
+
+    # -- multi-tenant job surface (serve/) -----------------------------------
+    @route("POST", r"/w/jobs", locked=False)
+    def submit_job(self, body):
+        """Admit a batched job.  202 + id on success; 429 + Retry-After
+        when admission control refuses (queue full) — the client backs
+        off instead of wedging an HTTP worker."""
+        spec = json.loads(body)
+        try:
+            job = self.jobs.submit(spec)
+        except QueueFullError as e:
+            return Response(
+                {"error": str(e), "queueFull": True},
+                429,
+                {"Retry-After": str(e.retry_after_s)},
+            )
+        return Response(
+            {
+                "id": job.id,
+                "state": job.state.value,
+                "compat": job.compat,
+                "queueDepth": self.jobs.queue.depth(),
+            },
+            202,
+        )
+
+    @route("GET", r"/w/jobs", locked=False)
+    def list_jobs(self, body):
+        return {
+            "scheduler": self.jobs.status(),
+            "jobs": [
+                {"id": j.id, "state": j.state.value, "kind": j.kind}
+                for j in self.jobs.queue.jobs()
+            ],
+        }
+
+    @route("GET", r"/w/jobs/(?P<jid>[^/?]+)", locked=False)
+    def job_status(self, body, jid):
+        try:
+            job = self.jobs.queue.get(jid)
+        except UnknownJobError:
+            return Response({"error": f"no such job {jid!r}"}, 404)
+        return job.to_dict()
+
+    @route(
+        "GET",
+        r"/w/jobs/(?P<jid>[^/?]+)/result(?:\?(?P<query>.*))?",
+        locked=False,
+    )
+    def job_result(self, body, jid, query=None):
+        """Result pickup.  ``?waitS=N`` blocks up to N seconds for the
+        job to finish (long-poll); otherwise a pending job answers 202
+        + Retry-After so clients poll instead of holding sockets."""
+        from urllib.parse import parse_qs
+
+        try:
+            job = self.jobs.queue.get(jid)
+        except UnknownJobError:
+            return Response({"error": f"no such job {jid!r}"}, 404)
+        wait_s = 0.0
+        if query:
+            vals = parse_qs(query).get("waitS")
+            if vals:
+                wait_s = min(float(vals[0]), 600.0)
+        if wait_s > 0:
+            job.done_event.wait(wait_s)
+        if job.state is JobState.DONE:
+            return {"id": job.id, "state": job.state.value,
+                    "result": job.result}
+        if job.state is JobState.FAILED:
+            return Response(
+                {"id": job.id, "state": job.state.value,
+                 "error": job.error}, 500,
+            )
+        if job.state is JobState.CANCELLED:
+            return Response(
+                {"id": job.id, "state": job.state.value}, 410,
+            )
+        return Response(
+            {"id": job.id, "state": job.state.value, "ready": False},
+            202,
+            {"Retry-After": str(self.jobs.retry_after_s())},
+        )
+
+    @route("DELETE", r"/w/jobs/(?P<jid>[^/?]+)", locked=False)
+    def cancel_job(self, body, jid):
+        try:
+            job = self.jobs.cancel(jid)
+        except UnknownJobError:
+            return Response({"error": f"no such job {jid!r}"}, 404)
+        return job.to_dict()
 
     @route("GET", r"/w/network/nodes")
     def nodes(self, body):
@@ -278,17 +393,17 @@ class WServer:
         print(f"external_sink received: {body[:200]}")
         return []
 
-    @route("POST", r"/w/sweep", locked=False)
-    def sweep(self, body):
-        """Batch-sweep job: run a protocol `runs` times (seed = run index,
-        RunMultipleTimes.java:48-63) and return the aggregated stats."""
+    @staticmethod
+    def _run_legacy_sweep(spec: dict) -> dict:
+        """The original /w/sweep body: run a protocol `runs` times
+        (seed = run index, RunMultipleTimes.java:48-63) and return the
+        aggregated stats."""
         import wittgenstein_tpu.protocols  # noqa: F401  (fills the registry)
 
         from ..core import stats as SH
         from ..core.params import protocol_registry
         from ..core.runners import RunMultipleTimes
 
-        spec = json.loads(body)
         reg = protocol_registry[spec["protocol"]]
         params = reg.params_cls.from_dict(spec.get("params", {}))
         p = reg.factory(params)
@@ -310,6 +425,36 @@ class WServer:
         for g, st in zip(getters, stats):
             out.append({f: st.get(f) for f in g.fields()})
         return {"protocol": spec["protocol"], "runs": spec.get("runs", 1), "stats": out}
+
+    @route("POST", r"/w/sweep", locked=False)
+    def sweep(self, body):
+        """Batch-sweep job, routed through the serve/ job queue instead
+        of running inside this handler thread: the sweep takes one
+        worker turn under the scheduler (admission control applies — a
+        full queue answers 503 + Retry-After instead of wedging), while
+        the handler blocks on the job for the legacy response shape."""
+        spec = json.loads(body)
+        try:
+            job = self.jobs.submit_legacy(
+                lambda: self._run_legacy_sweep(spec)
+            )
+        except QueueFullError as e:
+            return Response(
+                {"error": str(e), "queueFull": True},
+                503,
+                {"Retry-After": str(e.retry_after_s)},
+            )
+        job.done_event.wait(600.0)
+        if job.exc is not None:
+            raise job.exc  # preserve the legacy error mapping (_invoke)
+        if job.state is not JobState.DONE:
+            return Response(
+                {"error": f"sweep job {job.id} did not finish "
+                 f"(state={job.state.value})"},
+                503,
+                {"Retry-After": str(self.jobs.retry_after_s())},
+            )
+        return job.result
 
     # -- dispatch ------------------------------------------------------------
     def dispatch(self, method: str, path: str, body: str) -> Tuple[int, object]:
@@ -389,6 +534,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_PUT(self):
         self._do("PUT")
+
+    def do_DELETE(self):
+        self._do("DELETE")
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
