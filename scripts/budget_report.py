@@ -50,6 +50,9 @@ def measure(node_ct: int) -> dict:
     """Build the flagship config at `node_ct` and measure all three
     budget inputs.  One full run (telemetry-armed, quiescence exit) for
     ticks/sim; one AOT compile of the bare program for cost/memory."""
+    import dataclasses
+
+    from wittgenstein_tpu.engine.capacity import load_capacity, lookup
     from wittgenstein_tpu.profiling import (
         budget_from_parts,
         flagship_params,
@@ -68,9 +71,14 @@ def measure(node_ct: int) -> dict:
     # default would drop the cache leaves on this CPU run and understate
     # the TPU state the replicas/chip model must hold).  Tick counts are
     # bit-identical across both levers, so ticks_per_sim is unaffected.
-    net, state = make_handel(
-        flagship_params(node_ct), score_cache=True, fuse_step=True
-    )
+    params = flagship_params(node_ct)
+    # telemetry-sized capacity: the autotuned cand_slots for this node
+    # count (scripts/density_autotune.py -> CAPACITY.json) — bit-identical
+    # by the re-sort argument (docs/density.md), absent table = default K
+    cap = lookup(load_capacity(ROOT), "handel", node_ct)
+    if cap is not None and "cand_slots" in cap.sized:
+        params = dataclasses.replace(params, cand_slots=cap.sized["cand_slots"])
+    net, state = make_handel(params, score_cache=True, fuse_step=True)
 
     # (2) the compiled bare program: compile cost + XLA cost/memory.
     # stop_when_done=True is the bench path — the budget prices the
@@ -110,6 +118,8 @@ def measure(node_ct: int) -> dict:
             "sim_ms": SIM_MS,
             "stop_when_done": True,
             "channel_depth": net.protocol.CHANNEL_DEPTH,
+            "cand_slots": net.protocol.CAND_SLOTS,
+            "capacity_table": cap is not None,
             "loop": {k: int(v) for k, v in loop.items()},
         },
     )

@@ -57,12 +57,22 @@ def main() -> None:
     tracer = SpanTracer(f"phase-profile handel{nodes}x{replicas}")
     # handel-internal phases (this script's table) on the SHARED timing
     # loop — bench --phase-profile times the engine-generic set instead
+    def _iso(fn):
+        # internal phases consume/produce the int32 compute view; apply
+        # the same NARROW_LEAVES widen/narrow boundary the tick wrapper
+        # does so the scanned carry keeps the narrow storage dtypes
+        def run(s):
+            out = fn(net, s._replace(proto=proto.widen_proto(s.proto)))
+            return out._replace(proto=proto.narrow_proto(out.proto))
+
+        return run
+
     phases = {
         "full step": lambda s: net.step(s),
-        "channel_deliver": lambda s: proto._channel_deliver(net, s),
-        "commit": lambda s: proto._commit(net, s),
-        "dissemination": lambda s: proto._dissemination(net, s),
-        "select": lambda s: proto._select(net, s),
+        "channel_deliver": _iso(proto._channel_deliver),
+        "commit": _iso(proto._commit),
+        "dissemination": _iso(proto._dissemination),
+        "select": _iso(proto._select),
     }
     t = scan_phase_seconds(states, phases, scans, tracer)
     full = t["full step"]["mean_s"]

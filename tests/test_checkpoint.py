@@ -285,3 +285,81 @@ class TestCheckpointManager:
         mgr = CheckpointManager(str(tmp_path))
         assert mgr.restore_latest(self._toy(0)) is None
         assert mgr.latest_step() is None
+
+
+class TestCheckpointV3Compat:
+    """timewheel-v2 -> v3 restore shim: compat-era int32 leaves cast
+    onto narrow templates under a range check, with the INT32_MAX
+    sentinel remapped to the narrow dtype's max (docs/durability.md)."""
+
+    def test_v2_restores_bitwise_into_narrow_layout(
+        self, tmp_path, monkeypatch
+    ):
+        import jax
+
+        import wittgenstein_tpu.engine.checkpoint as cp
+        import wittgenstein_tpu.engine.core as core_mod
+        from wittgenstein_tpu.engine import density
+        from wittgenstein_tpu.protocols.pingpong_batched import make_pingpong
+
+        # the narrow (v3) run and its int32-lane (v2-era) twin of the
+        # SAME sim — bit-identical dynamics by the engine's
+        # storage-narrow/compute-int32 rule
+        net_n, s_n = make_pingpong(64)
+        out_n = net_n.run_ms(s_n, 80)
+        monkeypatch.setattr(
+            core_mod,
+            "lane_plan",
+            lambda n, t, narrow=None: density.lane_plan(n, t, False),
+        )
+        net_w, s_w = make_pingpong(64)
+        out_w = net_w.run_ms(s_w, 80)
+        assert np.asarray(out_w.msg_from).dtype == np.int32
+        assert np.asarray(out_n.msg_from).dtype.itemsize < 4
+
+        ckpt = str(tmp_path / "v2.npz")
+        monkeypatch.setattr(cp, "ENGINE_LAYOUT", "timewheel-v2")
+        save_state(out_w, ckpt)
+        monkeypatch.undo()
+
+        back = load_state(out_n, ckpt)
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(back)[0],
+            jax.tree_util.tree_flatten_with_path(out_n)[0],
+        ):
+            assert np.asarray(a).dtype == np.asarray(b).dtype, pa
+            assert (np.asarray(a) == np.asarray(b)).all(), pa
+
+    def test_v2_sentinel_remap_and_range_check(self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+
+        import wittgenstein_tpu.engine.checkpoint as cp
+        from wittgenstein_tpu.engine.checkpoint import CheckpointShapeError
+
+        INT32_MAX = np.iinfo(np.int32).max
+        ckpt = str(tmp_path / "v2s.npz")
+        monkeypatch.setattr(cp, "ENGINE_LAYOUT", "timewheel-v2")
+        save_state({"cand": jnp.array([3, INT32_MAX, 0], jnp.int32)}, ckpt)
+        bad = str(tmp_path / "v2bad.npz")
+        save_state({"cand": jnp.array([70000, 0, 0], jnp.int32)}, bad)
+        monkeypatch.undo()
+
+        tmpl = {"cand": jnp.zeros(3, jnp.int16)}
+        back = load_state(tmpl, ckpt)
+        assert np.asarray(back["cand"]).dtype == np.int16
+        assert np.asarray(back["cand"]).tolist() == [
+            3, np.iinfo(np.int16).max, 0,
+        ]
+        # values the narrow dtype cannot represent refuse loudly
+        with pytest.raises(CheckpointShapeError):
+            load_state(tmpl, bad)
+
+    def test_v3_dtype_mismatch_still_hard_fails(self, tmp_path):
+        import jax.numpy as jnp
+
+        from wittgenstein_tpu.engine.checkpoint import CheckpointShapeError
+
+        ckpt = str(tmp_path / "v3.npz")
+        save_state({"cand": jnp.array([1, 2], jnp.int32)}, ckpt)
+        with pytest.raises(CheckpointShapeError):
+            load_state({"cand": jnp.zeros(2, jnp.int16)}, ckpt)

@@ -397,6 +397,44 @@ def test_sl407_detects_deliver_fault_write():
     )
 
 
+def test_sl901_detects_live_dtype_mismatch():
+    from wittgenstein_tpu.analysis.contracts import check_entry
+    from wittgenstein_tpu.engine.density import NarrowLeaf
+    from wittgenstein_tpu.protocols.pingpong_batched import BatchedPingPong
+
+    class UnnarrowedInit(BatchedPingPong):
+        # declares a narrow plan but proto_init (inherited) still seeds
+        # the leaf at int32 — the narrow_proto() call was forgotten
+        NARROW_LEAVES = (NarrowLeaf("pong", "int8", 100),)
+
+    findings = check_entry(
+        _entry_with_protocol(UnnarrowedInit), root=str(REPO_ROOT)
+    )
+    assert any(
+        f.rule == "SL901" and "pong" in f.message and "int32" in f.message
+        for f in findings
+    )
+
+
+def test_sl901_detects_headroom_violation():
+    from wittgenstein_tpu.analysis.contracts import check_entry
+    from wittgenstein_tpu.engine.density import NarrowLeaf
+    from wittgenstein_tpu.protocols.pingpong_batched import BatchedPingPong
+
+    class NoSentinelRoom(BatchedPingPong):
+        # int8 max is 127, but the sentinel declaration reserves it:
+        # declared_max 127 leaves no slot for the empty marker
+        NARROW_LEAVES = (NarrowLeaf("pong", "int8", 127, sentinel=True),)
+
+    findings = check_entry(
+        _entry_with_protocol(NoSentinelRoom), root=str(REPO_ROOT)
+    )
+    assert any(
+        f.rule == "SL901" and "127" in f.message and "sentinel" in f.message
+        for f in findings
+    )
+
+
 def test_sl601_clean_on_pingpong():
     from wittgenstein_tpu.analysis.annotations_check import (
         check_annotations_entry,

@@ -1,4 +1,4 @@
-"""Abstract-eval contract checks (SL401-SL404, SL406-SL407, SL701).
+"""Abstract-eval contract checks (SL401-SL404, SL406-SL407, SL701, SL901).
 
 These rules run the real engine code under JAX's abstract interpreter
 instead of reading its text: every protocol registered in
@@ -39,6 +39,12 @@ SL701  derived-cache consistency: a protocol declaring
        ticks (so deliver, commits and periodic work all execute) and
        every declared leaf is compared bitwise against the oracle — a
        stale-cache bug cannot ship silently.
+SL901  narrow-dtype overflow audit: the engine's message-lane plan must
+       cover (N-1, n_msg_types-1), every NARROW_LEAVES declaration
+       (engine.density) must match its live leaf's dtype with the
+       sentinel slot kept free, and after concrete steps every
+       non-sentinel value must stay inside [0, declared_max] — the bound
+       the storage dtype was chosen by.
 
 Protocol-level suppression: list rule ids in the protocol class's
 SIMLINT_SUPPRESS tuple (the dynamic analog of `# simlint: disable=`).
@@ -455,6 +461,110 @@ def _check_derived_cache(jax, name, net, state, path, line, suppress):
     return findings
 
 
+def _check_narrow_overflow(jax, name, net, state, path, line, suppress):
+    """SL901: narrow packed dtypes must have provable headroom.  Audits
+    (a) the engine's message-lane plan against the config's actual
+    bounds, (b) every declared NarrowLeaf statically (live dtype matches
+    the declaration; declared_max leaves the sentinel slot free), and
+    (c) the declaration dynamically: after concrete steps every
+    non-sentinel value must sit in [0, declared_max].  Skipped (clean)
+    for protocols that declare no NARROW_LEAVES and run int32 lanes."""
+    import numpy as np
+
+    findings = []
+    # (a) engine lanes: the plan is computed from (N, n_msg_types), so a
+    # mismatch means someone forced narrow_lanes past the bounds
+    lanes = getattr(net, "lanes", None)
+    if lanes is not None:
+        bounds = (
+            ("idx", max(0, net.n_nodes - 1), "node index"),
+            ("mtype", max(0, net.protocol.n_msg_types() - 1),
+             "message type"),
+        )
+        for attr, bound, what in bounds:
+            dt = np.dtype(getattr(lanes, attr))
+            if np.issubdtype(dt, np.integer) and bound > np.iinfo(dt).max:
+                f = _mk("SL901", path, line,
+                        f"[{name}] engine lane '{attr}' stores {what} "
+                        f"values up to {bound} in {dt} (max "
+                        f"{np.iinfo(dt).max}) — the lane plan was "
+                        "overridden past its bound", suppress)
+                if f:
+                    findings.append(f)
+    specs = tuple(getattr(net.protocol, "NARROW_LEAVES", ()) or ())
+    if not specs:
+        return findings
+    proto = state.proto
+    if not isinstance(proto, dict):
+        f = _mk("SL901", path, line,
+                f"[{name}] declares NARROW_LEAVES but state.proto is not "
+                "a dict, so the leaves cannot exist", suppress)
+        return findings + ([f] if f else [])
+    # (b) static: declaration vs the live initial state
+    for spec in specs:
+        want = np.dtype(spec.dtype)
+        info = np.iinfo(want)
+        headroom = info.max - (1 if spec.sentinel else 0)
+        if int(spec.declared_max) > headroom:
+            f = _mk("SL901", path, line,
+                    f"[{name}] NarrowLeaf '{spec.name}' declares max "
+                    f"{spec.declared_max} but {want} holds only "
+                    f"{headroom}"
+                    f"{' (top value reserved for the sentinel)' if spec.sentinel else ''}",
+                    suppress)
+            if f:
+                findings.append(f)
+        if spec.name not in proto:
+            f = _mk("SL901", path, line,
+                    f"[{name}] NarrowLeaf '{spec.name}' is declared but "
+                    "absent from the initial state.proto (config-gated "
+                    "leaves are fine at runtime, but the registry entry "
+                    "should exercise every declaration)", suppress)
+            if f:
+                findings.append(f)
+            continue
+        live = np.dtype(proto[spec.name].dtype)
+        if live != want:
+            f = _mk("SL901", path, line,
+                    f"[{name}] NarrowLeaf '{spec.name}' declares {want} "
+                    f"but the live leaf is {live} — proto_init forgot "
+                    "narrow_proto(), or the declaration is stale",
+                    suppress)
+            if f:
+                findings.append(f)
+    if findings:
+        return findings
+    # (c) dynamic: concrete steps must keep every non-sentinel value in
+    # the declared range (the bound the static audit trusted)
+    try:
+        stepped = state
+        for _ in range(8):
+            stepped = net.step(stepped)
+    except Exception as e:
+        f = _mk("SL901", path, line,
+                f"[{name}] concrete stepping for the narrow-range check "
+                f"failed: {type(e).__name__}: {e}", suppress)
+        return [f] if f else []
+    for spec in specs:
+        if spec.name not in stepped.proto:
+            continue  # disappearance is SL401's finding
+        arr = np.asarray(stepped.proto[spec.name])
+        if spec.sentinel:
+            arr = arr[arr != np.iinfo(arr.dtype).max]
+        if arr.size and (
+            int(arr.min()) < 0 or int(arr.max()) > int(spec.declared_max)
+        ):
+            f = _mk("SL901", path, line,
+                    f"[{name}] NarrowLeaf '{spec.name}' observed values "
+                    f"in [{int(arr.min())}, {int(arr.max())}] after 8 "
+                    f"concrete steps, outside its declared "
+                    f"[0, {spec.declared_max}] — the bound the dtype "
+                    "choice rests on is wrong", suppress)
+            if f:
+                findings.append(f)
+    return findings
+
+
 def _check_recompile(jax, name, net, state, out_shape, path, line, suppress):
     """SL404: step output avals == input avals (jit-cache stability) and
     trace determinism."""
@@ -491,7 +601,8 @@ def _check_recompile(jax, name, net, state, out_shape, path, line, suppress):
 
 
 def check_entry(entry, root: str = ".") -> List[Finding]:
-    """Run SL401-SL404 + SL406-SL407 + SL701 for one registry entry; []
+    """Run SL401-SL404 + SL406-SL407 + SL701 + SL901 for one registry
+    entry; []
     when clean or when the entry opts out of contract checks (standalone
     engines)."""
     jax = _cpu_jax()
@@ -521,6 +632,9 @@ def check_entry(entry, root: str = ".") -> List[Finding]:
         jax, entry.name, net, state, path, line, suppress
     )
     findings += _check_derived_cache(
+        jax, entry.name, net, state, path, line, suppress
+    )
+    findings += _check_narrow_overflow(
         jax, entry.name, net, state, path, line, suppress
     )
     findings += _check_recompile(

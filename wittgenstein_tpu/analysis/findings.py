@@ -84,6 +84,13 @@ RULES: Dict[str, str] = {
     "share the exact static-config digest and row leaf signature, and "
     "re-dispatching an identical workload must be a pure run-cache hit "
     "(no recompile-per-batch regression)",
+    # -- narrow-dtype overflow audit -------------------------------------------
+    "SL901": "narrow-dtype overflow audit: an engine message lane or a "
+    "declared NARROW_LEAVES leaf (engine.density) cannot hold its bound "
+    "— lane plan overridden past (N-1, n_msg_types-1), declared_max "
+    "over the dtype's headroom (sentinel slot included), live leaf "
+    "dtype diverging from its declaration, or concrete steps producing "
+    "values outside [0, declared_max]",
 }
 
 

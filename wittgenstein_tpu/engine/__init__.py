@@ -8,7 +8,16 @@ under `jax.lax.scan`, `jax.vmap` over replicas, and `jax.sharding` over
 devices.
 """
 
+from .capacity import (
+    CapacityEntry,
+    load_capacity,
+    lookup,
+    size_from_hwm,
+    sized_overrides,
+    validate_table,
+)
 from .core import BatchedNetwork, Emission, SimState, replicate_state, stack_states
+from .density import LanePlan, NarrowLeaf, lane_plan, narrowest_int
 from .protocol import (
     ENGINE_OWNED_FIELDS,
     HOST_HOOKS,
@@ -20,10 +29,20 @@ from .rng import hash32, pseudo_delta
 __all__ = [
     "BatchedNetwork",
     "BatchedProtocol",
+    "CapacityEntry",
     "Emission",
+    "LanePlan",
+    "NarrowLeaf",
     "SimState",
     "hash32",
+    "lane_plan",
+    "load_capacity",
+    "lookup",
+    "narrowest_int",
     "pseudo_delta",
     "replicate_state",
+    "size_from_hwm",
+    "sized_overrides",
     "stack_states",
+    "validate_table",
 ]

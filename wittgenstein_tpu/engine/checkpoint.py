@@ -38,6 +38,13 @@ Layout-stamp compatibility rules (also in docs/durability.md):
 - A v2 checkpoint whose side-car signature differs from the template's
   (e.g. saved with telemetry ON, loaded with telemetry OFF) fails the
   same way before any leaf is touched.
+- ``timewheel-v2`` (pre-narrow-dtype) checkpoints store int32 where the
+  v3 layout packs int16/int8 (engine.density); leaves whose shape
+  matches cast on load under a range check, with the stored INT32_MAX
+  sentinel remapped to the narrow dtype's max.  Handel-family v2
+  checkpoints fail on SHAPE instead (``CheckpointShapeError``): the same
+  generation regrouped their channel buckets to exact widths, so their
+  in_sig leaves genuinely cannot resume — re-run those.
 """
 
 from __future__ import annotations
@@ -74,13 +81,19 @@ def _path_str(path) -> str:
 # era can never resume on this engine — fail with the reason, not with a
 # leaf-by-leaf shape mismatch.  v2 = v1 wheel layout + side-car aware
 # manifest (telemetry/fault state signatures + per-leaf checksums).
+# v3 = v2 + narrow packed dtypes (engine.density): message lanes and
+# declared NARROW_LEAVES store int16/int8 where int32 used to live, with
+# INT32_MAX sentinels remapped to the narrow dtype's own max.
 LAYOUT_KEY = "__engine_layout__"
 MANIFEST_KEY = "__manifest__"
-ENGINE_LAYOUT = "timewheel-v2"
+ENGINE_LAYOUT = "timewheel-v3"
 # older stamps this engine can still load, with restrictions enforced in
 # load_state (v1 predates the side-car signature, so it only loads into
-# an uninstrumented template)
-COMPAT_LAYOUTS = ("timewheel-v1",)
+# an uninstrumented template; v2 stored int32 where the template may now
+# be narrow — leaves whose SHAPE matches cast on load under a range
+# check, sentinel-mapped; a v2 Handel checkpoint fails on shape instead,
+# because the exact-width channel buckets regrouped its in_sig leaves)
+COMPAT_LAYOUTS = ("timewheel-v1", "timewheel-v2")
 MANIFEST_FORMAT = 2
 
 # SimState leaves that a checkpoint may legitimately omit (none today:
@@ -219,16 +232,61 @@ def _check_layout(src: str, found: str, template: Any) -> None:
     )
 
 
+def _coerce_dtype(src: str, key: str, arr, want_dtype):
+    """The v2->v3 restore shim: cast a compat-era int32 leaf onto the
+    template's narrow dtype (engine.density pattern).
+
+    Valid only for integer->narrower-integer casts where every stored
+    value is exactly representable: the source dtype's own max (the
+    INT32_MAX "never"/empty sentinel) maps to the narrow dtype's max —
+    the value the narrow layout reserves for the same role — and every
+    other value must already fit the narrow range.  Anything else is a
+    real layout mismatch and keeps the hard CheckpointShapeError."""
+    a, w = arr.dtype, np.dtype(want_dtype)
+    if not (
+        np.issubdtype(a, np.integer)
+        and np.issubdtype(w, np.integer)
+        and np.iinfo(a).max > np.iinfo(w).max
+    ):
+        raise CheckpointShapeError(
+            f"leaf {key!r}: checkpoint {src} stores dtype {a}, template "
+            f"wants {w} — not a compat-era widening to cast down"
+        )
+    src_max = np.iinfo(a).max
+    dst = np.iinfo(w)
+    is_sent = arr == src_max
+    rest = arr[~is_sent]
+    if rest.size and (
+        int(rest.min()) < dst.min or int(rest.max()) > dst.max
+    ):
+        raise CheckpointShapeError(
+            f"leaf {key!r}: checkpoint {src} holds values in "
+            f"[{int(rest.min())}, {int(rest.max())}] that do not fit the "
+            f"template's {w} — the narrow layout cannot represent this "
+            "state; re-run instead of resuming"
+        )
+    out = arr.astype(w)
+    out[is_sent] = dst.max
+    return out
+
+
 def load_state(template: Any, src: str, verify: bool = True) -> Any:
     """Rebuild a state pytree with `template`'s structure from `src`.
 
-    Shapes and dtypes must match the template's leaves; with `verify`
-    (default) every leaf is also checked against its manifest crc32, so
-    silent bit-rot surfaces as CheckpointCorruptError naming the leaf.
+    Shapes must match the template's leaves; dtypes must match too,
+    except when a COMPAT-era checkpoint stores a wider integer than the
+    template's narrow leaf (the timewheel-v3 dtype shrink) — those cast
+    on load under a range check with sentinel remapping
+    (``_coerce_dtype``).  With `verify` (default) every leaf is also
+    checked against its manifest crc32 — computed on the STORED bytes,
+    before any cast — so silent bit-rot surfaces as
+    CheckpointCorruptError naming the leaf.
     """
     with _open_npz(src) as data:
-        if LAYOUT_KEY in data:
-            _check_layout(src, str(data[LAYOUT_KEY]), template)
+        found_layout = str(data[LAYOUT_KEY]) if LAYOUT_KEY in data else None
+        if found_layout is not None:
+            _check_layout(src, found_layout, template)
+        compat = found_layout in COMPAT_LAYOUTS
         manifest = None
         if MANIFEST_KEY in data:
             try:
@@ -266,7 +324,9 @@ def load_state(template: Any, src: str, verify: bool = True) -> Any:
                     f"(truncated archive?): {e}"
                 ) from e
             want = np.asarray(leaf)
-            if arr.shape != want.shape or arr.dtype != want.dtype:
+            if arr.shape != want.shape or (
+                arr.dtype != want.dtype and not compat
+            ):
                 raise CheckpointShapeError(
                     f"leaf {key!r}: checkpoint has {arr.shape}/{arr.dtype}, "
                     f"template wants {want.shape}/{want.dtype}"
@@ -283,6 +343,8 @@ def load_state(template: Any, src: str, verify: bool = True) -> Any:
                             "the file is corrupt; falling back to an "
                             "older checkpoint is safe, this one is not"
                         )
+            if arr.dtype != want.dtype:
+                arr = _coerce_dtype(src, key, arr, want.dtype)
             leaves.append(jax.numpy.asarray(arr))
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(template), leaves

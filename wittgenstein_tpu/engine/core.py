@@ -70,6 +70,7 @@ from ..telemetry.state import (
     init_telemetry,
     record_snapshot,
 )
+from .density import lane_plan
 from .rng import hash32, pseudo_delta
 
 MAX_PARTITIONS = 4
@@ -128,20 +129,23 @@ class SimState(NamedTuple):
     # time wheel [W, B]: row r holds messages with eff-arrival ≡ r (mod W).
     # The msg_* names are shared with the delivery view handed to
     # protocol.deliver (flat [D] gathers of the due rows + overflow).
+    # id/type lanes are STORED at the engine's lane_plan dtypes (int16
+    # ids when N fits, int8/int16 types per the mtype count) and widened
+    # to int32 at the delivery-view gather — see engine.density
     msg_valid: jnp.ndarray  # bool[W, B]
     msg_arrival: jnp.ndarray  # int32[W, B]
-    msg_from: jnp.ndarray  # int32[W, B]
-    msg_to: jnp.ndarray  # int32[W, B]
-    msg_type: jnp.ndarray  # int32[W, B]
+    msg_from: jnp.ndarray  # lanes.idx[W, B]
+    msg_to: jnp.ndarray  # lanes.idx[W, B]
+    msg_type: jnp.ndarray  # lanes.mtype[W, B]
     msg_payload: jnp.ndarray  # int32[W, B, P]
     whl_fill: jnp.ndarray  # int32[W]: valid entries per row (dense prefix)
     # overflow lane [V]: beyond-horizon arrivals + full-row spill; scanned
     # (arrival <= t) every tick like the old flat ring, but V << W*B
     ovf_valid: jnp.ndarray  # bool[V]
     ovf_arrival: jnp.ndarray  # int32[V]
-    ovf_from: jnp.ndarray  # int32[V]
-    ovf_to: jnp.ndarray  # int32[V]
-    ovf_type: jnp.ndarray  # int32[V]
+    ovf_from: jnp.ndarray  # lanes.idx[V]
+    ovf_to: jnp.ndarray  # lanes.idx[V]
+    ovf_type: jnp.ndarray  # lanes.mtype[V]
     ovf_payload: jnp.ndarray  # int32[V, P]
     msg_head: jnp.ndarray  # int32 scalar: monotone sent-message counter
     dropped: jnp.ndarray  # int32 scalar: wheel+overflow overflow count
@@ -210,6 +214,7 @@ class BatchedNetwork:
         faults: Optional["FaultConfig"] = None,
         annotate: bool = True,
         fuse_step: bool = False,
+        narrow_lanes: Optional[bool] = None,
     ):
         self.protocol = protocol
         self.latency = latency
@@ -244,6 +249,13 @@ class BatchedNetwork:
         self.payload_width = protocol.PAYLOAD_WIDTH
         sizes = [protocol.msg_size(t) for t in range(protocol.n_msg_types())]
         self._msg_sizes = np.asarray(sizes, dtype=np.int32)
+        # STATIC storage dtype plan for the message lanes (engine.density,
+        # docs/density.md): ids/types are CARRIED narrow and widened back
+        # to int32 at the delivery-view gather, so every protocol kernel
+        # still sees the exact int32 program it was verified against.
+        # narrow_lanes=False pins the historical all-int32 lanes — the
+        # baseline side of the bit-identity sweep (tests/test_density.py)
+        self.lanes = lane_plan(n_nodes, protocol.n_msg_types(), narrow_lanes)
 
         if wheel_rows is None:
             wheel_rows = DEFAULT_WHEEL_ROWS
@@ -321,16 +333,16 @@ class BatchedNetwork:
             partition_x=jnp.full(MAX_PARTITIONS, INT_MAX, dtype=jnp.int32),
             msg_valid=jnp.zeros((w, b), dtype=bool),
             msg_arrival=jnp.full((w, b), INT_MAX, dtype=jnp.int32),
-            msg_from=zi((w, b)),
-            msg_to=zi((w, b)),
-            msg_type=zi((w, b)),
+            msg_from=jnp.zeros((w, b), dtype=self.lanes.idx),
+            msg_to=jnp.zeros((w, b), dtype=self.lanes.idx),
+            msg_type=jnp.zeros((w, b), dtype=self.lanes.mtype),
             msg_payload=zi((w, b, p)),
             whl_fill=zi(w),
             ovf_valid=jnp.zeros(v, dtype=bool),
             ovf_arrival=jnp.full(v, INT_MAX, dtype=jnp.int32),
-            ovf_from=zi(v),
-            ovf_to=zi(v),
-            ovf_type=zi(v),
+            ovf_from=jnp.zeros(v, dtype=self.lanes.idx),
+            ovf_to=jnp.zeros(v, dtype=self.lanes.idx),
+            ovf_type=jnp.zeros(v, dtype=self.lanes.mtype),
             ovf_payload=zi((v, p)),
             msg_head=jnp.int32(0),
             dropped=jnp.int32(0),
@@ -376,6 +388,7 @@ class BatchedNetwork:
             self.faults.key() if self.faults is not None else None,
             self.annotate,
             self.fuse_step,
+            self.lanes.key(),
             # the bitset-kernel backend is read from the environment at
             # trace time (WITT_BITOPS) — fold it in so a flipped override
             # can't be served a stale compiled program
@@ -653,10 +666,14 @@ class BatchedNetwork:
                 msg_arrival=state.msg_arrival.at[w_row, w_slot].set(
                     arrival, mode="drop"
                 ),
-                msg_from=state.msg_from.at[w_row, w_slot].set(from_idx, mode="drop"),
-                msg_to=state.msg_to.at[w_row, w_slot].set(to_idx, mode="drop"),
+                msg_from=state.msg_from.at[w_row, w_slot].set(
+                    from_idx.astype(self.lanes.idx), mode="drop"
+                ),
+                msg_to=state.msg_to.at[w_row, w_slot].set(
+                    to_idx.astype(self.lanes.idx), mode="drop"
+                ),
                 msg_type=state.msg_type.at[w_row, w_slot].set(
-                    mtype_rows, mode="drop"
+                    mtype_rows.astype(self.lanes.mtype), mode="drop"
                 ),
                 whl_fill=state.whl_fill.at[w_row].add(
                     fits.astype(jnp.int32), mode="drop"
@@ -692,9 +709,15 @@ class BatchedNetwork:
         state = state._replace(
             ovf_valid=state.ovf_valid.at[pos].set(True, mode="drop"),
             ovf_arrival=state.ovf_arrival.at[pos].set(arrival, mode="drop"),
-            ovf_from=state.ovf_from.at[pos].set(from_idx, mode="drop"),
-            ovf_to=state.ovf_to.at[pos].set(to_idx, mode="drop"),
-            ovf_type=state.ovf_type.at[pos].set(mtype_rows, mode="drop"),
+            ovf_from=state.ovf_from.at[pos].set(
+                from_idx.astype(self.lanes.idx), mode="drop"
+            ),
+            ovf_to=state.ovf_to.at[pos].set(
+                to_idx.astype(self.lanes.idx), mode="drop"
+            ),
+            ovf_type=state.ovf_type.at[pos].set(
+                mtype_rows.astype(self.lanes.mtype), mode="drop"
+            ),
             # head is not an allocator; kept as a monotone sent-message
             # counter for observability
             msg_head=state.msg_head + n_ok,
@@ -772,9 +795,18 @@ class BatchedNetwork:
 
         view_valid = jnp.concatenate([wv.reshape(-1), state.ovf_valid])
         view_arrival = jnp.concatenate([wa.reshape(-1), state.ovf_arrival])
-        view_from = jnp.concatenate([wf.reshape(-1), state.ovf_from])
-        view_to = jnp.concatenate([wt.reshape(-1), state.ovf_to])
-        view_type = jnp.concatenate([wk.reshape(-1), state.ovf_type])
+        # the ONE widening point of the narrow-lane plan: protocols (and
+        # every engine consumer below) see int32 ids/types regardless of
+        # the storage dtypes, so kernels are unchanged by the plan
+        view_from = jnp.concatenate(
+            [wf.reshape(-1), state.ovf_from]
+        ).astype(jnp.int32)
+        view_to = jnp.concatenate(
+            [wt.reshape(-1), state.ovf_to]
+        ).astype(jnp.int32)
+        view_type = jnp.concatenate(
+            [wk.reshape(-1), state.ovf_type]
+        ).astype(jnp.int32)
         view_payload = jnp.concatenate(
             [wp.reshape(q * b, -1), state.ovf_payload], axis=0
         )
@@ -1000,13 +1032,13 @@ class BatchedNetwork:
                         jnp.full(w_shape, INT_MAX, jnp.int32)
                     ),
                     msg_from=state.msg_from.at[ctx[0]].set(
-                        jnp.zeros(w_shape, jnp.int32)
+                        jnp.zeros(w_shape, dtype=self.lanes.idx)
                     ),
                     msg_to=state.msg_to.at[ctx[0]].set(
-                        jnp.zeros(w_shape, jnp.int32)
+                        jnp.zeros(w_shape, dtype=self.lanes.idx)
                     ),
                     msg_type=state.msg_type.at[ctx[0]].set(
-                        jnp.zeros(w_shape, jnp.int32)
+                        jnp.zeros(w_shape, dtype=self.lanes.mtype)
                     ),
                     msg_payload=(
                         state.msg_payload.at[ctx[0]].set(

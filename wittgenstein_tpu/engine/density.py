@@ -1,0 +1,139 @@
+"""Replica-density dtype plans: narrow carried-state integers, int32 compute.
+
+sims/s/chip is linear in R = replicas-per-chip, and R is bounded by
+bytes/replica (profiling/hbm.py) — so every carried `SimState` integer
+that fits a narrower dtype is a direct multiplier on the north star.
+The house rule that keeps this free of correctness risk:
+
+  * STORAGE is narrow: message-lane columns (`msg_from/msg_to/msg_type`
+    and their overflow twins) and protocol leaves declared via
+    `BatchedProtocol.NARROW_LEAVES` are carried at the narrowest dtype
+    their declared bound fits;
+  * COMPUTE is int32: the engine widens the lanes at the delivery-view
+    gather and protocols widen declared leaves at kernel-hook entry
+    (`widen_tree`) / re-narrow at exit (`narrow_tree`), so every kernel
+    body still sees exactly the int32 program it was verified against —
+    narrowing is bit-identical by construction, not by luck.
+
+Sentinel mapping: several protocol leaves use INT32_MAX as an "empty"
+sentinel (e.g. Handel's `cand_rank`).  A narrowed leaf stores the narrow
+dtype's own max instead, and the widen/narrow pair maps the two
+loss-lessly; the dtype's max value is therefore RESERVED and the leaf's
+declared_max must stay strictly below it (audited by simlint SL901).
+
+The per-protocol capacity sizing that rides with the dtype plan lives in
+engine/capacity.py; docs/density.md is the user-facing story.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+INT32_MAX = np.int32(2**31 - 1)
+
+# lanes never narrow below int16: the (8,128)-tile padding on TPU makes
+# sub-int16 message lanes a wash, and int8 ids would cap N at 127
+_LANE_DTYPES = (np.int16, np.int32)
+_LEAF_DTYPES = (np.int8, np.int16, np.int32)
+
+
+def narrowest_int(max_value: int, *, reserve_sentinel: bool = False,
+                  candidates=_LEAF_DTYPES) -> np.dtype:
+    """Narrowest signed dtype whose range holds [0, max_value] (plus the
+    reserved sentinel slot when asked)."""
+    for dt in candidates:
+        hi = np.iinfo(dt).max - (1 if reserve_sentinel else 0)
+        if max_value <= hi:
+            return np.dtype(dt)
+    raise ValueError(f"max_value {max_value} does not fit int32")
+
+
+@dataclasses.dataclass(frozen=True)
+class LanePlan:
+    """Storage dtypes for the engine's message-lane columns."""
+
+    idx: np.dtype  # msg_from / msg_to / ovf_from / ovf_to
+    mtype: np.dtype  # msg_type / ovf_type
+
+    def key(self) -> tuple:
+        return (self.idx.name, self.mtype.name)
+
+
+def lane_plan(n_nodes: int, n_msg_types: int,
+              narrow: "bool | None" = None) -> LanePlan:
+    """The engine's dtype plan for one (N, mtype-count) config.
+
+    narrow=None means auto (narrow whenever the bound fits); False pins
+    the historical all-int32 lanes — the baseline side of the
+    narrow-vs-int32 bit-identity sweep."""
+    if narrow is None:
+        narrow = True
+    if not narrow:
+        return LanePlan(np.dtype(np.int32), np.dtype(np.int32))
+    idx = narrowest_int(max(0, n_nodes - 1), candidates=_LANE_DTYPES)
+    mtype = narrowest_int(max(0, n_msg_types - 1))
+    return LanePlan(idx, mtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class NarrowLeaf:
+    """One protocol leaf's narrowing declaration (the NARROW_LEAVES
+    contract): carried at `dtype`, every non-sentinel value provably in
+    [0, declared_max] given the protocol's static geometry (N, levels,
+    window bounds ...).  simlint SL901 audits the declaration statically
+    (headroom incl. the sentinel slot) and dynamically (concrete steps
+    must keep every value in range)."""
+
+    name: str
+    dtype: str  # "int8" | "int16"
+    declared_max: int
+    sentinel: bool = False  # INT32_MAX <-> iinfo(dtype).max mapping
+
+    def key(self) -> tuple:
+        return (self.name, self.dtype, int(self.declared_max),
+                bool(self.sentinel))
+
+
+def narrow_leaf(x, spec: NarrowLeaf):
+    """int32 -> declared storage dtype (sentinel-mapped)."""
+    dt = jnp.dtype(spec.dtype)
+    y = x.astype(dt)
+    if spec.sentinel:
+        y = jnp.where(x == INT32_MAX,
+                      jnp.asarray(np.iinfo(dt).max, dt), y)
+    return y
+
+
+def widen_leaf(x, spec: NarrowLeaf):
+    """Declared storage dtype -> int32 compute (sentinel-mapped)."""
+    y = x.astype(jnp.int32)
+    if spec.sentinel:
+        y = jnp.where(x == np.iinfo(np.dtype(spec.dtype)).max,
+                      jnp.asarray(INT32_MAX, jnp.int32), y)
+    return y
+
+
+def narrow_tree(proto: dict, specs) -> dict:
+    """Re-narrow declared leaves of a proto dict (absent leaves — e.g.
+    config-gated caches — are skipped; everything else passes through)."""
+    if not specs:
+        return proto
+    out = dict(proto)
+    for spec in specs:
+        if spec.name in out:
+            out[spec.name] = narrow_leaf(out[spec.name], spec)
+    return out
+
+
+def widen_tree(proto: dict, specs) -> dict:
+    """Widen declared leaves of a proto dict to int32 compute."""
+    if not specs:
+        return proto
+    out = dict(proto)
+    for spec in specs:
+        if spec.name in out:
+            out[spec.name] = widen_leaf(out[spec.name], spec)
+    return out

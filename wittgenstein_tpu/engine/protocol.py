@@ -103,6 +103,17 @@ class BatchedProtocol:
     # caches match a from-scratch recompute bitwise, so stale-cache bugs
     # can't ship silently.
     DERIVED_CACHE_LEAVES: tuple = ()
+    # Narrow-storage declarations (engine.density.NarrowLeaf): proto
+    # leaves CARRIED below int32, each with the dtype, the provable value
+    # bound given the protocol's static geometry, and whether the leaf
+    # uses the INT32_MAX "empty" sentinel (stored as the narrow dtype's
+    # max, which is then reserved).  Kernel hooks must call
+    # widen_proto()/narrow_proto() at their boundary so every kernel body
+    # still computes in int32 — the narrowing is bit-identical by
+    # construction.  simlint SL901 audits the declarations (static
+    # headroom + concrete-step range check); docs/density.md is the
+    # full story.  Usually set per-INSTANCE (the bounds depend on N).
+    NARROW_LEAVES: tuple = ()
 
     def contract(self) -> dict:
         """Machine-readable contract summary (instance-level: factories may
@@ -124,6 +135,7 @@ class BatchedProtocol:
             "deliver_may_touch": list(self.DELIVER_MAY_TOUCH),
             "simlint_suppress": list(self.SIMLINT_SUPPRESS),
             "derived_cache_leaves": list(self.DERIVED_CACHE_LEAVES),
+            "narrow_leaves": [s.key() for s in self.NARROW_LEAVES],
         }
 
     def n_msg_types(self) -> int:
@@ -168,6 +180,24 @@ class BatchedProtocol:
         phase order interleaves dense and beat-gated phases, e.g.
         HandelEth2's commit -> start/stop+dissemination -> select)."""
         return state
+
+    def widen_proto(self, proto):
+        """NARROW_LEAVES -> int32 compute view of a proto dict (kernel-hook
+        entry).  Identity when nothing is declared."""
+        if not self.NARROW_LEAVES:
+            return proto
+        from .density import widen_tree
+
+        return widen_tree(proto, self.NARROW_LEAVES)
+
+    def narrow_proto(self, proto):
+        """int32 compute view -> declared storage dtypes (kernel-hook exit
+        and proto_init).  Identity when nothing is declared."""
+        if not self.NARROW_LEAVES:
+            return proto
+        from .density import narrow_tree
+
+        return narrow_tree(proto, self.NARROW_LEAVES)
 
     def recompute_caches(self, state) -> dict:
         """From-scratch values for every DERIVED_CACHE_LEAVES leaf, as a

@@ -20,6 +20,7 @@ witt_run_cache_* Prometheus families.
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import OrderedDict
 from typing import Tuple
@@ -46,6 +47,10 @@ def shard_replicas(states, mesh: Mesh, axis: str = "replicas"):
 # (and the engines/latency tables their closures hold) for process life.
 _RUN_CACHE: "OrderedDict[tuple, _CachedRun]" = OrderedDict()
 _RUN_CACHE_MAX = 64
+# entry creation is check-then-act; concurrent callers (serve batch
+# workers, sweep threads) must not each install their own _CachedRun
+# for one key — that duplicates the compile despite the per-entry lock
+_CACHE_LOCK = threading.Lock()
 
 # monotonic across clear_run_cache() — Prometheus counters must never
 # step backwards just because a campaign flushed the program cache
@@ -91,6 +96,12 @@ class _CachedRun:
         self._jit = fn
         self._programs: "OrderedDict[tuple, object]" = OrderedDict()
         self._summaries: "OrderedDict[tuple, dict]" = OrderedDict()
+        # XLA compiles release the GIL, so two threads calling with the
+        # same input geometry can BOTH observe "not compiled yet" and
+        # duplicate a multi-second compile (observed from concurrent
+        # serve batches).  Double-checked locking keeps the per-geometry
+        # compile a true singleton.
+        self._compile_lock = threading.Lock()
 
     @staticmethod
     def _signature(states) -> tuple:
@@ -110,18 +121,21 @@ class _CachedRun:
         sig = self._signature(states)
         compiled = self._programs.get(sig)
         if compiled is None:
-            t0 = time.perf_counter()
-            compiled = self._jit.lower(states).compile()
-            dt = time.perf_counter() - t0
-            _COUNTERS["compiles"] += 1
-            _COUNTERS["compile_seconds_total"] += dt
-            self._programs[sig] = compiled
-            self._summaries[sig] = {
-                "replicas": next(
-                    (s[0][0] for s in sig if s[0]), None
-                ),
-                **compiled_cost_summary(compiled, dt),
-            }
+            with self._compile_lock:
+                compiled = self._programs.get(sig)
+                if compiled is None:
+                    t0 = time.perf_counter()
+                    compiled = self._jit.lower(states).compile()
+                    dt = time.perf_counter() - t0
+                    _COUNTERS["compiles"] += 1
+                    _COUNTERS["compile_seconds_total"] += dt
+                    self._programs[sig] = compiled
+                    self._summaries[sig] = {
+                        "replicas": next(
+                            (s[0][0] for s in sig if s[0]), None
+                        ),
+                        **compiled_cost_summary(compiled, dt),
+                    }
         return compiled(states)
 
     def summaries(self) -> list:
@@ -162,19 +176,20 @@ def _run_and_reduce(net, sim_ms: int):
     with an equivalent network hit the cache instead of re-tracing the
     full simulation."""
     key = (net.cache_key(), int(sim_ms))
-    fn = _RUN_CACHE.get(key)
-    if fn is not None:
-        _COUNTERS["hits"] += 1
-        _RUN_CACHE.move_to_end(key)
-        return fn
+    with _CACHE_LOCK:
+        fn = _RUN_CACHE.get(key)
+        if fn is not None:
+            _COUNTERS["hits"] += 1
+            _RUN_CACHE.move_to_end(key)
+            return fn
 
-    _COUNTERS["misses"] += 1
-    fn = _CachedRun(net, sim_ms, key)
-    _RUN_CACHE[key] = fn
-    while len(_RUN_CACHE) > _RUN_CACHE_MAX:
-        _RUN_CACHE.popitem(last=False)
-        _COUNTERS["evictions"] += 1
-    return fn
+        _COUNTERS["misses"] += 1
+        fn = _CachedRun(net, sim_ms, key)
+        _RUN_CACHE[key] = fn
+        while len(_RUN_CACHE) > _RUN_CACHE_MAX:
+            _RUN_CACHE.popitem(last=False)
+            _COUNTERS["evictions"] += 1
+        return fn
 
 
 def sharded_run_stats(net, states, sim_ms: int) -> Tuple[jax.Array, dict]:

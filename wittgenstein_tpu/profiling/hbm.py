@@ -27,26 +27,34 @@ DEFAULT_STATE_OVERHEAD = 2.0
 
 def state_bytes_per_replica(state) -> dict:
     """Total bytes of one replica's SimState pytree and the top
-    contributors: {"total_bytes", "n_leaves", "top": [(path, bytes)]}.
+    contributors: {"total_bytes", "n_leaves", "top": [(path, bytes,
+    dtype)], "by_dtype": {dtype: bytes}}.
 
     `state` must be UNREPLICATED (no leading replica axis) — pass the
-    init_state() result, not replicate_state()'s."""
+    init_state() result, not replicate_state()'s.  The dtype axis is the
+    density war's ledger: narrow packed leaves (engine.density) show up
+    here as int16/int8 bytes that would otherwise be int32."""
     import jax
 
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(state)[0]
     sizes = []
+    by_dtype: dict = {}
     total = 0
     for path, leaf in leaves_with_paths:
+        dt = getattr(leaf, "dtype", None)
         nb = int(getattr(leaf, "size", 0)) * int(
-            getattr(getattr(leaf, "dtype", None), "itemsize", 0) or 0
+            getattr(dt, "itemsize", 0) or 0
         )
         total += nb
-        sizes.append((jax.tree_util.keystr(path), nb))
+        dname = str(dt) if dt is not None else "none"
+        by_dtype[dname] = by_dtype.get(dname, 0) + nb
+        sizes.append((jax.tree_util.keystr(path), nb, dname))
     sizes.sort(key=lambda kv: -kv[1])
     return {
         "total_bytes": total,
         "n_leaves": len(sizes),
         "top": sizes[:8],
+        "by_dtype": dict(sorted(by_dtype.items(), key=lambda kv: -kv[1])),
     }
 
 
@@ -82,12 +90,15 @@ def hbm_report(
     cross-check.  `memory` is xla_cost.memory_analysis_dict() output for
     a run_ms program on ONE replica of this state."""
     density = replicas_per_chip(state, hbm_gib=hbm_gib)
+    per = state_bytes_per_replica(state)
     out = {
         "model": density,
         "top_leaves": [
-            {"path": p, "bytes": b}
-            for p, b in state_bytes_per_replica(state)["top"]
+            {"path": p, "bytes": b, "dtype": d} for p, b, d in per["top"]
         ],
+        # the narrow-dtype ledger: how much of the replica is already
+        # packed below int32 (engine.density lane plans + NARROW_LEAVES)
+        "bytes_by_dtype": per["by_dtype"],
     }
     if memory:
         # measured live bytes for 1 replica vs the modeled

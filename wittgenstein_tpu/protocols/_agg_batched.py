@@ -75,11 +75,17 @@ class Bucket:
         return len(self.levels)
 
 
-def _width_class(w: int) -> int:
-    """Bucket class of a level width: {1} {2,4} {8,16} {32,64} ..."""
-    if w == 1:
-        return 0
-    return (w.bit_length() + 1) // 2
+"""Bucket grouping is by EXACT width (the PR-11 density pass): levels of
+equal word width share a bucket (the sub-word levels, all w=1), and wider
+levels get their own — so w_pad always equals the levels' exact width and
+the channel/candidate arrays carry zero padding words.  The r4 rewrite
+grouped width CLASSES ({2,4}, {8,16}, ...) instead, paying up to 2x
+padding per bucket to halve the bucket count; with per-bucket bodies now
+a minority of compile time, the padding was pure HBM waste (13.4 MB of
+the 4096-node flagship's 124 MiB/replica).  Every phase iterates
+`self.buckets` generically, so the regrouping is a pure layout change —
+per-level arithmetic is untouched and results are bit-identical (padding
+words were always zero)."""
 
 
 class BitsetAggBase(BatchedProtocol):
@@ -92,8 +98,12 @@ class BitsetAggBase(BatchedProtocol):
         """Periodic dissemination as the engine's beat hook (subclasses
         implement _dissemination with exactly ONE stacked send, matching
         BEAT_SEND_CALLS; it commutes with _select — no shared proto keys,
-        order-independent channel competition)."""
-        return self._dissemination(net, state)
+        order-independent channel competition).  Wrapped in the
+        NARROW_LEAVES widen/narrow boundary (identity for declarers of
+        none, e.g. GSF) so the hook body computes on the int32 view."""
+        state = state._replace(proto=self.widen_proto(state.proto))
+        state = self._dissemination(net, state)
+        return state._replace(proto=self.narrow_proto(state.proto))
 
     def _init_geometry(self, n: int) -> None:
         if n & (n - 1):
@@ -118,13 +128,13 @@ class BitsetAggBase(BatchedProtocol):
             self.w[l] = max(1, (1 << (l - 1)) // 32)
         self.w_max = self.w[self.n_levels - 1] if self.n_levels > 1 else 1
 
-        # width buckets over levels 1..L-1
+        # exact-width buckets over levels 1..L-1 (see module docstring):
+        # consecutive levels of EQUAL width share a bucket, so w_pad is
+        # always the exact width and no padding words are carried
         buckets = []
         for l in range(1, self.n_levels):
-            cls = _width_class(self.w[l])
-            if buckets and _width_class(buckets[-1][1]) == cls:
+            if buckets and buckets[-1][1] == self.w[l]:
                 buckets[-1][0].append(l)
-                buckets[-1][1] = max(buckets[-1][1], self.w[l])
             else:
                 buckets.append([[l], self.w[l]])
         self.buckets = [Bucket(tuple(lv), wp) for lv, wp in buckets]

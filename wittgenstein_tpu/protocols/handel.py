@@ -55,6 +55,13 @@ class HandelParameters(WParameters):
     # (receiver, level); None = the engine default.  Trades HBM for lower
     # message displacement — see BatchedHandel.CHANNEL_DEPTH
     channel_depth: Optional[int] = None
+    # batched-engine knob (no oracle effect): verification-candidate slots
+    # per (receiver, level); None = the engine default.  Sized from the
+    # measured occupancy high-water mark by scripts/density_autotune.py —
+    # bit-identical while occupancy stays under the slot count (the K
+    # buffer is re-sorted every tick, so a top-K' of an under-occupied
+    # top-K retains the same entries).  See BatchedHandel.CAND_SLOTS
+    cand_slots: Optional[int] = None
 
     def __post_init__(self):
         from ._aggregation import normalize_agg_params

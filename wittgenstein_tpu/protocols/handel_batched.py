@@ -149,10 +149,69 @@ class BatchedHandel(BitsetAggBase):
                     f"channel_depth={params.channel_depth} must be positive"
                 )
             self.CHANNEL_DEPTH = params.channel_depth  # instance override
+        if params.cand_slots is not None:
+            if params.cand_slots <= 0:
+                raise ValueError(
+                    f"cand_slots={params.cand_slots} must be positive"
+                )
+            self.CAND_SLOTS = params.cand_slots  # instance override
         self._init_geometry(params.node_count)
         self.DERIVED_CACHE_LEAVES = (
             self.CACHE_LEAF_NAMES if self.SCORE_CACHE else ()
         )
+        # blacklist + byzantine bitsets are carried only when an attack can
+        # ever set a bit in them (byzantineSuicide writes bl, both attacks
+        # read byz); attack-free replicas — the flagship density config —
+        # drop both [N, n_words] planes from the carried state entirely.
+        # Every read site is gated on this flag, so the attack-free program
+        # is the all-zero-bl program with the (no-op) bl terms elided.
+        self.track_bad = bool(
+            params.byzantine_suicide or params.hidden_byzantine
+        )
+        self.NARROW_LEAVES = self._narrow_plan()
+
+    def _narrow_plan(self) -> tuple:
+        """NARROW_LEAVES for this instance's geometry (engine.density,
+        docs/density.md).  Every bound is provable from static parameters:
+
+          cand_rank  rank = per-receiver permutation of [0, N) plus the
+                     +N verified-sender demotion -> < 2N; INT32_MAX empty
+                     sentinel (stored as the narrow dtype max)
+          cand_rel / ver_rel  relative peer ids, < N
+          ver_level / fp_level  level numbers, <= L-1
+          fp_left    fastPath burst countdown, <= min(fast_path, N/2)
+          window     clamped to [window_minimum, window_maximum] and the
+                     selected level's size
+          cand_s / cand_card / cand_wind  popcounts over one level block
+                     (block size <= N/2; N is a safe static bound)
+          cand_aggi  boolean flag carried as an integer
+
+        Leaves whose bound already needs int32 are omitted (narrowing
+        would be a no-op); widen_proto/narrow_proto skip absent leaves, so
+        the cache entries are inert when SCORE_CACHE is off."""
+        from ..engine.density import NarrowLeaf, narrowest_int
+
+        p, n, L = self.params, self.n_nodes, self.n_levels
+        fp_max = max(1, min(p.fast_path, max(1, n // 2)))
+        bounds = (
+            ("cand_rank", 2 * n - 1, True),
+            ("cand_rel", max(1, n - 1), False),
+            ("ver_level", max(1, L - 1), False),
+            ("ver_rel", max(1, n - 1), False),
+            ("fp_level", max(1, L - 1), False),
+            ("fp_left", fp_max, False),
+            ("window", max(p.window_initial, p.window_maximum), False),
+            ("cand_s", n, False),
+            ("cand_card", n, False),
+            ("cand_wind", n, False),
+            ("cand_aggi", 1, False),
+        )
+        leaves = []
+        for name, bound, sentinel in bounds:
+            dt = narrowest_int(bound, reserve_sentinel=sentinel)
+            if dt.itemsize < 4:
+                leaves.append(NarrowLeaf(name, dt.name, bound, sentinel))
+        return tuple(leaves)
 
     def msg_size(self, mtype: int) -> int:
         # Size = level + bit field + the signatures included + our own sig
@@ -212,8 +271,6 @@ class BatchedHandel(BitsetAggBase):
         n, L, K = self.n_nodes, self.n_levels, self.CAND_SLOTS
         own = np.zeros((n, self.n_words), dtype=np.uint32)
         own[:, 0] = 1  # bit 0 = own signature (level 0)
-        if byz_rel is None:
-            byz_rel = np.zeros((n, self.n_words), dtype=np.uint32)
         in_key, in_sigs = self._channel_init(n)
         cand_sigs = {
             f"cand_sig{i}": jnp.zeros((n, b.nl * K * b.w_pad), jnp.uint32)
@@ -223,8 +280,6 @@ class BatchedHandel(BitsetAggBase):
             "agg": jnp.asarray(own),  # lastAggVerified per level block
             "ind": jnp.asarray(own),  # verifiedIndSignatures
             "inc": jnp.asarray(own),  # totalIncoming = agg | ind
-            "bl": jnp.zeros((n, self.n_words), jnp.uint32),  # blacklist (rel)
-            "byz": jnp.asarray(byz_rel),  # down Byzantine peers (rel space)
             # stage 1: in-flight channel (D arrival slots + 1 fresh backstop
             # per level; see BitsetAggBase)
             "in_key": in_key,
@@ -253,9 +308,16 @@ class BatchedHandel(BitsetAggBase):
             "pairing": jnp.asarray(pairing, jnp.int32),
             "start_at": jnp.asarray(start_at, jnp.int32),
         }
+        if self.track_bad:
+            # blacklist (rel space) + down Byzantine peers (rel space) —
+            # carried only when an attack can set them (see __init__)
+            proto["bl"] = jnp.zeros((n, self.n_words), jnp.uint32)
+            if byz_rel is None:
+                byz_rel = np.zeros((n, self.n_words), dtype=np.uint32)
+            proto["byz"] = jnp.asarray(byz_rel)
         if self.SCORE_CACHE:
             proto.update(self._recompute_cache_dict(proto))
-        return proto
+        return self.narrow_proto(proto)
 
     # -- candidate-score caches (SCORE_CACHE) --------------------------------
     def _recompute_cache_dict(self, proto) -> dict:
@@ -296,7 +358,11 @@ class BatchedHandel(BitsetAggBase):
     def recompute_caches(self, state) -> dict:
         if not self.SCORE_CACHE:
             return {}
-        return self._recompute_cache_dict(state.proto)
+        # oracle recompute on the int32 view, re-narrowed so the returned
+        # leaves match the carried storage dtypes exactly (the SL701 and
+        # checkpoint-template comparisons are dtype-strict)
+        caches = self._recompute_cache_dict(self.widen_proto(state.proto))
+        return self.narrow_proto(caches)
 
     # -- tick phase 1: commit due verifications ------------------------------
     def _commit(self, net, state):
@@ -309,13 +375,17 @@ class BatchedHandel(BitsetAggBase):
         ids = jnp.arange(n, dtype=jnp.int32)
 
         due = proto["ver_active"] & (t >= proto["ver_done_t"])
-        bad = due & proto["ver_bad"]
         good = due & ~proto["ver_bad"]
 
-        # bad sig: blacklist the sender, nothing else (:687-694)
         rel = proto["ver_rel"]
-        oh_full = self._onehot(rel, self.n_words)
-        new_bl = jnp.where(bad[:, None], proto["bl"] | oh_full, proto["bl"])
+        new_bl = None
+        if self.track_bad:
+            # bad sig: blacklist the sender, nothing else (:687-694)
+            bad = due & proto["ver_bad"]
+            oh_full = self._onehot(rel, self.n_words)
+            new_bl = jnp.where(
+                bad[:, None], proto["bl"] | oh_full, proto["bl"]
+            )
 
         agg, ind, inc = proto["agg"], proto["ind"], proto["inc"]
         lvl = proto["ver_level"]
@@ -415,17 +485,18 @@ class BatchedHandel(BitsetAggBase):
                 "cand_wind": cw3.reshape(n, (L - 1) * K),
                 "cand_aggi": ca3.reshape(n, (L - 1) * K),
             }
+        upd = dict(
+            agg=agg,
+            ind=ind,
+            inc=inc,
+            ver_active=proto["ver_active"] & ~due,
+            **cache_fix,
+        )
+        if self.track_bad:
+            upd["bl"] = new_bl
         state = state._replace(
             done_at=jnp.where(done_now, t, state.done_at),
-            proto=dict(
-                proto,
-                agg=agg,
-                ind=ind,
-                inc=inc,
-                bl=new_bl,
-                ver_active=proto["ver_active"] & ~due,
-                **cache_fix,
-            ),
+            proto=dict(proto, **upd),
         )
 
         # fastPath burst (:738-742): on completing a level's incoming set,
@@ -525,8 +596,9 @@ class BatchedHandel(BitsetAggBase):
         )
 
         # onNewSig drop filters: not started, done, blacklisted sender
-        bl_bit = self._getbit(proto["bl"], rel2)
-        accept = due2 & started[:, None, None] & not_done[:, None, None] & (bl_bit == 0)
+        accept = due2 & started[:, None, None] & not_done[:, None, None]
+        if self.track_bad:
+            accept = accept & (self._getbit(proto["bl"], rel2) == 0)
 
         # rank + verified-sender demotion (receptionRanks += nodeCount)
         ind_bit = self._getbit(proto["ind"], rel2)
@@ -535,7 +607,8 @@ class BatchedHandel(BitsetAggBase):
         ) + self.n_nodes * ind_bit.astype(jnp.int32)
         rank2 = jnp.where(accept, rank2, INT32_MAX)
 
-        inc, ind, bl = proto["inc"], proto["ind"], proto["bl"]
+        inc, ind = proto["inc"], proto["ind"]
+        bl = proto["bl"] if self.track_bad else None
         agg = proto["agg"]
         rank_pieces, rel_pieces = [], []
         s_pieces, card_pieces, wind_pieces, aggi_pieces = [], [], [], []
@@ -604,8 +677,9 @@ class BatchedHandel(BitsetAggBase):
                 )
                 s = popcount_words(c | ind_b[:, :, None, :])  # sizeIfIncluded
             cur = popcount_words(inc_b)
-            bl_all = self._getbit(bl, all_rel)
-            keep = valid & (s > cur[:, :, None]) & (bl_all == 0)
+            keep = valid & (s > cur[:, :, None])
+            if self.track_bad:
+                keep = keep & (self._getbit(bl, all_rel) == 0)
 
             # sort key: higher sizeIfIncluded first, then lower rank;
             # bounded (s <= bs <= N/2, rank < 3N) so s*4N + rank fits int32
@@ -755,13 +829,9 @@ class BatchedHandel(BitsetAggBase):
         # selection SCORES on comes from the boundary view
         free = ~proto["ver_active"] & ~state.down & (t >= proto["start_at"] + 1)
         window = proto["window"]
-        inc, ind, agg, bl, byz = (
-            v["inc"],
-            v["ind"],
-            v["agg"],
-            v["bl"],
-            proto["byz"],
-        )
+        inc, ind, agg = v["inc"], v["ind"], v["agg"]
+        bl = v["bl"] if self.track_bad else None
+        byz = proto["byz"] if self.track_bad else None
 
         # per-level bests, one stacked body per bucket
         has_p, b_rank_p, b_rel_p, b_bad_p, b_kidx_p = [], [], [], [], []
@@ -797,8 +867,9 @@ class BatchedHandel(BitsetAggBase):
                 s = popcount_words(cc | ind_b[:, :, None, :])
                 cur_sig = self._sig_view(proto, i, K, prefix="cand_sig")
                 ccard_pieces.append(popcount_words(cur_sig))
-            bl_bit = self._getbit(bl, c_rel)
-            curated = valid & (s > popcount_words(inc_b)[:, :, None]) & (bl_bit == 0)
+            curated = valid & (s > popcount_words(inc_b)[:, :, None])
+            if self.track_bad:
+                curated = curated & (self._getbit(bl, c_rel) == 0)
             # permanent removal, like replaceToVerifyAgg (:612-618) —
             # recorded as a condemn mask, applied by ENTRY IDENTITY below
             condemn_pieces.append(valid & ~curated)
@@ -1046,6 +1117,16 @@ class BatchedHandel(BitsetAggBase):
 
     # -- engine hooks --------------------------------------------------------
     def tick(self, net, state):
+        # NARROW_LEAVES boundary (engine.density): the tick body — and the
+        # boundary-view snapshots it takes — compute on the int32 view;
+        # the carried state between ticks stores the declared narrow
+        # dtypes.  Bit-identical by construction: widen/narrow is a
+        # lossless sentinel-mapped cast both ways.
+        state = state._replace(proto=self.widen_proto(state.proto))
+        state = self._tick_impl(net, state)
+        return state._replace(proto=self.narrow_proto(state.proto))
+
+    def _tick_impl(self, net, state):
         # deliver FIRST: it decrements every occupied channel key by one
         # tick, so anything sent later in this tick (fastPath bursts in
         # _commit, dissemination in tick_beat) is first decremented next
@@ -1071,9 +1152,10 @@ class BatchedHandel(BitsetAggBase):
             return self._select(net, state)
         pre_cand = {k: state.proto[k] for k in self._cand_keys()}
         state = self._channel_deliver(net, state)
-        pre_merge = {
-            k: state.proto[k] for k in ("inc", "ind", "agg", "bl")
-        }
+        merge_keys = ("inc", "ind", "agg") + (
+            ("bl",) if self.track_bad else ()
+        )
+        pre_merge = {k: state.proto[k] for k in merge_keys}
         state = self._commit(net, state)
         state = self._select(net, state, view={**pre_cand, **pre_merge})
         return state

@@ -80,6 +80,19 @@ class BatchedP2PHandel(BatchedProtocol):
         self.DERIVED_CACHE_LEAVES = (
             self.CACHE_LEAF_NAMES if self.SCORE_CACHE else ()
         )
+        self.NARROW_LEAVES = self._narrow_plan()
+
+    def _narrow_plan(self) -> tuple:
+        """Density plan (engine.density, docs/density.md): ver_card is a
+        verified-signature cardinality, provably <= N; carried narrow,
+        computed in int32 inside the widen/narrow hook boundary.  Inert
+        when SCORE_CACHE is off (the leaf is absent)."""
+        from ..engine.density import NarrowLeaf, narrowest_int
+
+        dt = narrowest_int(self.n_nodes)
+        if dt.itemsize >= 4:
+            return ()
+        return (NarrowLeaf("ver_card", dt.name, self.n_nodes),)
 
     def msg_size(self, mtype: int) -> int:
         return 1  # dynamic in the reference; see the module docstring
@@ -119,12 +132,16 @@ class BatchedP2PHandel(BatchedProtocol):
             proto["cand"] = jnp.zeros((n, self.CAND_K, n), bool)
         if self.SCORE_CACHE:
             proto["ver_card"] = jnp.sum(verified, axis=1)
-        return proto
+        return self.narrow_proto(proto)
 
     def recompute_caches(self, state) -> dict:
         if not self.SCORE_CACHE:
             return {}
-        return {"ver_card": jnp.sum(state.proto["verified"], axis=-1)}
+        # re-narrowed so the returned leaf matches the carried storage
+        # dtype exactly (SL701 / checkpoint templates are dtype-strict)
+        return self.narrow_proto(
+            {"ver_card": jnp.sum(state.proto["verified"], axis=-1)}
+        )
 
     def initial_emissions(self, net, state):
         if not self.params.send_state:
@@ -147,6 +164,13 @@ class BatchedP2PHandel(BatchedProtocol):
 
     # -- message handling ----------------------------------------------------
     def deliver(self, net, state, deliver_mask):
+        # NARROW_LEAVES boundary (engine.density): hook bodies compute on
+        # the int32 view, carried state stores the declared narrow dtypes
+        state = state._replace(proto=self.widen_proto(state.proto))
+        state, ems = self._deliver_impl(net, state, deliver_mask)
+        return state._replace(proto=self.narrow_proto(state.proto)), ems
+
+    def _deliver_impl(self, net, state, deliver_mask):
         proto = dict(state.proto)
         n = self.n_nodes
         to, frm = state.msg_to, state.msg_from
@@ -185,6 +209,11 @@ class BatchedP2PHandel(BatchedProtocol):
 
     # -- per-tick ------------------------------------------------------------
     def tick(self, net, state):
+        state = state._replace(proto=self.widen_proto(state.proto))
+        state = self._tick_impl(net, state)
+        return state._replace(proto=self.narrow_proto(state.proto))
+
+    def _tick_impl(self, net, state):
         p = self.params
         proto = dict(state.proto)
         n = self.n_nodes

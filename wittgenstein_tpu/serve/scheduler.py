@@ -171,6 +171,7 @@ class BatchScheduler:
         self._batch_seq = 0
         self._ema_batch_s = 1.0
         self._worker: Optional[threading.Thread] = None
+        self._worker_lock = threading.Lock()
         self._stop = threading.Event()
 
     # -- admission -----------------------------------------------------
@@ -594,20 +595,27 @@ class BatchScheduler:
     # -- worker --------------------------------------------------------
 
     def start(self) -> None:
-        if self._worker is not None and self._worker.is_alive():
-            return
-        self._stop.clear()
-        self._worker = threading.Thread(
-            target=self._loop, daemon=True, name="witt-serve-worker"
-        )
-        self._worker.start()
+        # auto_start means every submit calls this: a burst of first
+        # requests races the is_alive check and, unguarded, each spawns
+        # its own (identically named) worker — concurrent workers then
+        # duplicate batch compiles.  ONE worker is the design.
+        with self._worker_lock:
+            if self._worker is not None and self._worker.is_alive():
+                return
+            self._stop.clear()
+            self._worker = threading.Thread(
+                target=self._loop, daemon=True, name="witt-serve-worker"
+            )
+            self._worker.start()
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
         self.queue.notify()
-        if self._worker is not None:
-            self._worker.join(timeout)
+        with self._worker_lock:
+            worker = self._worker
             self._worker = None
+        if worker is not None:
+            worker.join(timeout)
 
     def _loop(self) -> None:
         while not self._stop.is_set():
