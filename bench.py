@@ -239,6 +239,8 @@ def chunked_pass(
     checkpoint_every=1,
     tracer=None,
     on_report=None,
+    ctx=None,
+    recorder=None,
 ):
     """One budgeted chunked pass over an AOT executable — THE shared
     never-kill-mid-call loop (bench ladder + scripts/tpu_campaign.py both
@@ -280,6 +282,10 @@ def chunked_pass(
         budget_s=budget_s,
         consume_template=True,
         tracer=tracer,
+        # obs spine: the bench-entry TraceContext rides the supervisor's
+        # flight-recorder events and checkpoint manifests too
+        ctx=ctx,
+        recorder=recorder,
     )
     rep = sup.run()
     if on_report is not None:
@@ -358,12 +364,17 @@ def bench_batched(node_ct: int, n_replicas: int, budget_s: float = 1e9) -> dict:
 
     import contextlib
 
+    from wittgenstein_tpu.obs import mint_context
     from wittgenstein_tpu.telemetry import SpanTracer, counters
     from wittgenstein_tpu.tools.profiling import trace
 
+    # bench entry is a run_id mint point (the serve path's counterpart
+    # is job admission): the ctx correlates the span trace, the timed
+    # pass's flight-recorder events, and the emitted record
+    ctx = mint_context("bench")
     # host-phase span trace (compile is already gone by the timed pass;
     # chunks are spanned from the heartbeat timings chunked_pass reports)
-    tracer = SpanTracer(f"bench handel{node_ct}x{n_replicas}")
+    tracer = SpanTracer(f"bench handel{node_ct}x{n_replicas}", ctx=ctx)
     tracer.add_span("compile", 0.0, compile_s * 1e6, nodes=node_ct)
 
     profile_dir = os.environ.get("WITT_BENCH_PROFILE")
@@ -373,7 +384,7 @@ def bench_batched(node_ct: int, n_replicas: int, budget_s: float = 1e9) -> dict:
         with tracer.span("timed_pass", replicas=n_replicas):
             out, chunk_times, ok = run_chunked(
                 _fresh_states(), pass_budget,
-                tracer=tracer, on_report=reports.append,
+                tracer=tracer, on_report=reports.append, ctx=ctx,
             )
         run_s = time.perf_counter() - t0
     if not ok:
@@ -382,6 +393,7 @@ def bench_batched(node_ct: int, n_replicas: int, budget_s: float = 1e9) -> dict:
     if trace_path:
         tracer.write(trace_path)
     return {
+        "run_id": ctx.run_id,
         "sims_per_sec": n_replicas / run_s,
         "compile_s": round(compile_s, 1),
         "run_s": round(run_s, 3),

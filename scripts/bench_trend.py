@@ -1,0 +1,261 @@
+"""Bench trajectory: the committed BENCH_r*.json rounds as one
+machine-readable perf trend, with a CI regression gate.
+
+Every round's BENCH_rNN.json holds the bench harness's stdout tail —
+sometimes a clean ``parsed`` record, sometimes a truncated JSON record
+buried after XLA warning spew.  This script recovers what is
+recoverable from each round (sims/s, vs_baseline, config, compile/run
+seconds), derives µs/tick where the inputs exist (needs a
+ticks-per-sim census for the round's node count — BUDGET.json carries
+one for its committed config), attaches the BUDGET.json HBM model
+(MiB/replica) as the capacity reference, and emits the whole
+trajectory as JSON.
+
+``--check`` is the perf-trend gate (tier1.yml): it FAILS when the
+newest round comparable to BENCH_FLOOR.json (same node_count +
+n_replicas, a value actually recovered) falls below the floor.  The
+floor file is the documentation channel for accepted regressions — its
+note records why the current level is the accepted one and its
+re-record policy (±6% run-to-run spread on the 1-core box; engine
+rewrites re-anchor it).  A >10% drop between consecutive rounds is
+reported in the trajectory (``regressions``) but only fails the gate
+when the newer round ALSO breaks the floor: a drop the floor file
+absorbs is a documented regression, a drop below the floor is not.
+
+Usage:
+  python scripts/bench_trend.py [-o trend.json]
+  python scripts/bench_trend.py --check [-o trend.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: consecutive-round drop worth flagging in the trajectory
+REGRESSION_FRAC = 0.10
+
+
+def _extract_record(tail: str):
+    """Best-effort recovery of the LAST bench JSON record in a stdout
+    tail.  Tries json.loads at every '{"metric"' occurrence (records
+    may be truncated mid-object — raw_decode fails there, so fall back
+    to field-level regex on the remainder)."""
+    best = None
+    for m in re.finditer(r'\{"metric"', tail):
+        chunk = tail[m.start():]
+        try:
+            best = json.JSONDecoder().raw_decode(chunk)[0]
+            continue
+        except json.JSONDecodeError:
+            pass
+        # truncated record: scrape the scalar fields individually
+        rec = {}
+        for key, rx, conv in (
+            ("metric", r'"metric":\s*"([^"]+)"', str),
+            ("value", r'"value":\s*([0-9.eE+-]+)', float),
+            ("vs_baseline", r'"vs_baseline":\s*([0-9.eE+-]+)', float),
+            ("compile_s", r'"compile_s":\s*([0-9.eE+-]+)', float),
+            ("run_s", r'"run_s":\s*([0-9.eE+-]+)', float),
+            ("node_count", r'"node_count":\s*([0-9]+)', int),
+            ("n_replicas", r'"n_replicas":\s*([0-9]+)', int),
+            ("sim_ms", r'"sim_ms":\s*([0-9]+)', int),
+            ("chunk_ms", r'"chunk_ms":\s*([0-9]+)', int),
+        ):
+            got = re.search(rx, chunk)
+            if got:
+                rec[key] = conv(got.group(1))
+        if "value" in rec:
+            best = rec
+    return best
+
+
+def _load_budget(root: str):
+    try:
+        with open(os.path.join(root, "BUDGET.json")) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _round_row(path: str, budget) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    n = doc.get("n")
+    if n is None:
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        n = int(m.group(1)) if m else None
+    rec = doc.get("parsed") or {}
+    scraped = _extract_record(doc.get("tail", "") or "")
+    if scraped:
+        # the tail record is the fuller source (parsed is its prefix)
+        rec = {**rec, **scraped}
+    cfg = rec.get("config") or {}
+    node_count = cfg.get("node_count", rec.get("node_count"))
+    n_replicas = cfg.get("n_replicas", rec.get("n_replicas"))
+    row = {
+        "round": n,
+        "file": os.path.basename(path),
+        "metric": rec.get("metric"),
+        "sims_per_sec": rec.get("value"),
+        "vs_baseline": rec.get("vs_baseline"),
+        "node_count": node_count,
+        "n_replicas": n_replicas,
+        "sim_ms": cfg.get("sim_ms", rec.get("sim_ms")),
+        "chunk_ms": cfg.get("chunk_ms", rec.get("chunk_ms")),
+        "compile_s": rec.get("compile_s"),
+        "run_s": rec.get("run_s"),
+        "rc": doc.get("rc"),
+        # derivables, filled below when the inputs exist
+        "us_per_tick": None,
+        "mib_per_replica": None,
+    }
+    # µs/tick: R replicas in lockstep at S sims/s with T ticks/sim ->
+    # tick_us = R / (S*T) * 1e6.  T comes from BUDGET.json's census and
+    # is only valid for the budget's own node count.
+    if budget:
+        b_nodes = ((budget.get("config") or {}).get("node_count"))
+        ticks_per_sim = budget.get("ticks_per_sim")
+        if (
+            row["sims_per_sec"]
+            and ticks_per_sim
+            and node_count is not None
+            and b_nodes == node_count
+        ):
+            row["us_per_tick"] = round(
+                (n_replicas or 1)
+                / (row["sims_per_sec"] * ticks_per_sim)
+                * 1e6,
+                2,
+            )
+        hbm = ((budget.get("hbm") or {}).get("model") or {})
+        if hbm.get("mib_per_replica") and b_nodes == node_count:
+            row["mib_per_replica"] = hbm["mib_per_replica"]
+    return row
+
+
+def build_trend(root: str = ROOT) -> dict:
+    budget = _load_budget(root)
+    rows = [
+        _round_row(p, budget)
+        for p in sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    ]
+    rows.sort(key=lambda r: (r["round"] is None, r["round"]))
+    floor = None
+    try:
+        with open(os.path.join(root, "BENCH_FLOOR.json")) as f:
+            floor = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+
+    def comparable(r):
+        return (
+            floor is not None
+            and r["sims_per_sec"] is not None
+            and r["node_count"] == floor.get("node_count")
+            and r["n_replicas"] == floor.get("n_replicas")
+        )
+
+    comp = [r for r in rows if comparable(r)]
+    regressions = []
+    for prev, cur in zip(comp, comp[1:]):
+        drop = 1.0 - cur["sims_per_sec"] / prev["sims_per_sec"]
+        if drop > REGRESSION_FRAC:
+            regressions.append(
+                {
+                    "from_round": prev["round"],
+                    "to_round": cur["round"],
+                    "drop_frac": round(drop, 4),
+                    # absorbed by the committed floor -> documented
+                    "documented": bool(
+                        floor and cur["sims_per_sec"] >= floor["floor"]
+                    ),
+                }
+            )
+    trend = {
+        "schema": "witt-bench-trend/v1",
+        "rounds": rows,
+        "floor": floor,
+        "comparable_rounds": [r["round"] for r in comp],
+        "latest_comparable": comp[-1] if comp else None,
+        "regressions": regressions,
+        "budget": _load_budget(root),
+    }
+    return trend
+
+
+def check(trend: dict) -> list:
+    """Gate violations (empty = pass).  See module docstring for what
+    counts as documented."""
+    problems = []
+    floor = trend.get("floor")
+    if not floor:
+        return ["BENCH_FLOOR.json missing or unreadable — nothing to gate on"]
+    latest = trend.get("latest_comparable")
+    if latest is None:
+        problems.append(
+            "no BENCH round comparable to the floor config "
+            f"({floor.get('node_count')}x{floor.get('n_replicas')}) — "
+            "the gate cannot see the current perf level"
+        )
+        return problems
+    if latest["sims_per_sec"] < floor["floor"]:
+        problems.append(
+            f"round {latest['round']} ({latest['sims_per_sec']:.3f} sims/s) "
+            f"is below the committed floor {floor['floor']} — an "
+            "UNDOCUMENTED regression.  Either fix the perf or re-record "
+            "BENCH_FLOOR.json with a note explaining the accepted level "
+            "(the floor file is the documentation channel)."
+        )
+    for reg in trend.get("regressions", []):
+        if not reg["documented"]:
+            problems.append(
+                f"rounds r{reg['from_round']}->r{reg['to_round']} dropped "
+                f"{reg['drop_frac']:.1%} (> {REGRESSION_FRAC:.0%}) and the "
+                "newer round is below the floor — undocumented regression"
+            )
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on an undocumented >10%% regression")
+    ap.add_argument("-o", "--out", help="write the trend JSON here")
+    ap.add_argument("--root", default=ROOT,
+                    help="repo root holding BENCH_r*.json (tests)")
+    args = ap.parse_args(argv)
+    trend = build_trend(args.root)
+    if args.out:
+        d = os.path.dirname(os.path.abspath(args.out))
+        os.makedirs(d, exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(trend, f, indent=2, sort_keys=True)
+    else:
+        json.dump(trend, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    n_rows = len(trend["rounds"])
+    latest = trend.get("latest_comparable")
+    print(
+        f"bench_trend: {n_rows} round(s), latest comparable "
+        f"{('r%s @ %.3f sims/s' % (latest['round'], latest['sims_per_sec'])) if latest else 'none'}",
+        file=sys.stderr,
+    )
+    if args.check:
+        problems = check(trend)
+        for p in problems:
+            print(f"bench_trend FAIL: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print("bench_trend: gate PASS", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

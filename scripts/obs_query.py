@@ -1,0 +1,327 @@
+"""Flight-recorder query CLI: replay the per-run black box.
+
+The obs spine (wittgenstein_tpu/obs/) leaves JSONL event files behind —
+the tail-safe live file a FlightRecorder writes when armed with a path,
+and the atomic ``flight_recorder_dump.jsonl`` the supervisor drops
+beside the checkpoints on any typed failure.  This tool turns them back
+into something a human (or CI) can read:
+
+  timeline DUMP [DUMP...] [--run RUN_ID]
+      per-run, time-ordered text timeline: admission, packing, every
+      chunk with tick HWMs, retries, watchdog fires, kills, resumes —
+      multiple files (e.g. a SIGKILLed victim's and its resumer's)
+      merge into one timeline because they share one run_id.
+  trace DUMP [DUMP...] -o trace.json [--run RUN_ID]
+      the same events as a merged Chrome trace (chunk-start/chunk-end
+      pairs become complete spans, everything else instants) — opens in
+      chrome://tracing / Perfetto next to SpanTracer output and carries
+      the same run_id args.
+  runs DUMP [DUMP...]
+      the run_ids present, with event counts and time span (discovery).
+  collect OUT_DIR [ROOT...]
+      CI forensics: sweep ROOTs (default: $WITT_OBS_DIR and the serve
+      checkpoint temp dirs) for flight-recorder files and the newest
+      checkpoint manifest; copy them into OUT_DIR and render
+      timeline.txt there.  Used by tier1.yml's on-failure artifact step.
+
+Usage: python scripts/obs_query.py <command> ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from wittgenstein_tpu.obs import read_events  # noqa: E402
+
+# event fields worth showing in a one-line timeline summary, in order
+_SUMMARY_FIELDS = (
+    "protocol", "compat", "batch_id", "mode", "live_rows", "padding_rows",
+    "seconds", "ticks", "wheel_fill_hwm", "step", "reason", "error_kind",
+    "error", "fail_streak", "delay_s", "phase", "deadline_s", "run_key",
+    "chunks_done", "after_chunk", "depth", "queue_depth", "message",
+)
+
+
+def load_events(paths, run_id=None):
+    evs = read_events(list(paths))
+    if run_id:
+        evs = [e for e in evs if e.get("run_id") == run_id]
+    return evs
+
+
+def run_ids(events):
+    """run_id -> {events, t0, t1, kinds} summary, mint-ordered."""
+    out = {}
+    for e in events:
+        rid = e.get("run_id")
+        if rid is None:
+            continue
+        s = out.setdefault(
+            rid, {"events": 0, "t0": e["ts"], "t1": e["ts"], "kinds": {}}
+        )
+        s["events"] += 1
+        s["t0"] = min(s["t0"], e["ts"])
+        s["t1"] = max(s["t1"], e["ts"])
+        s["kinds"][e["kind"]] = s["kinds"].get(e["kind"], 0) + 1
+    return dict(sorted(out.items(), key=lambda kv: kv[1]["t0"]))
+
+
+def _summary(ev: dict) -> str:
+    parts = []
+    for k in _SUMMARY_FIELDS:
+        if k in ev:
+            parts.append(f"{k}={ev[k]}")
+    if "members" in ev:
+        parts.append(
+            "jobs=[" + ",".join(
+                f"{m.get('job_id')}:{m.get('tenant')}" for m in ev["members"]
+            ) + "]"
+        )
+    return " ".join(parts)
+
+
+def render_timeline(events) -> str:
+    """Human timeline: one line per event, offset from the first event,
+    grouped nothing — the interleaving IS the story (a resume line
+    appearing after a kill line is the durability contract made
+    visible)."""
+    if not events:
+        return "(no events)\n"
+    t0 = min(e["ts"] for e in events)
+    lines = []
+    for e in events:
+        rid = e.get("run_id", "-")
+        chunk = e.get("chunk_seq")
+        kind = e["kind"] + (f"[{chunk}]" if chunk is not None else "")
+        lines.append(
+            f"+{e['ts'] - t0:9.3f}s  {rid:<24} {kind:<18} {_summary(e)}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def to_chrome_trace(events) -> dict:
+    """Merged Chrome trace: chunk-start/chunk-end pairs (by run_id +
+    chunk_seq, nearest-start-first) become "X" complete spans; every
+    other event an "i" instant.  One pid lane per run_id.  Validated
+    against telemetry.trace.validate_chrome_trace before writing."""
+    if not events:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(e["ts"] for e in events)
+    pids = {}
+    trace_events = []
+
+    def pid_for(rid):
+        if rid not in pids:
+            pids[rid] = len(pids) + 1
+            trace_events.append(
+                {
+                    "ph": "M", "name": "process_name", "pid": pids[rid],
+                    "tid": 0, "args": {"name": f"run {rid}"},
+                }
+            )
+        return pids[rid]
+
+    open_starts = {}
+    for e in events:
+        rid = e.get("run_id", "?")
+        us = (e["ts"] - t0) * 1e6
+        key = (rid, e.get("chunk_seq"))
+        if e["kind"] == "chunk-start":
+            open_starts.setdefault(key, []).append(us)
+            continue
+        if e["kind"] == "chunk-end" and open_starts.get(key):
+            start = open_starts[key].pop(0)
+            trace_events.append(
+                {
+                    "ph": "X", "name": f"chunk {e.get('chunk_seq')}",
+                    "pid": pid_for(rid), "tid": 0,
+                    "ts": round(start, 1), "dur": round(us - start, 1),
+                    "args": {k: v for k, v in e.items() if k not in ("ts",)},
+                }
+            )
+            continue
+        trace_events.append(
+            {
+                "ph": "i", "name": e["kind"], "pid": pid_for(rid),
+                "tid": 0, "ts": round(us, 1), "s": "p",
+                "args": {k: v for k, v in e.items() if k not in ("ts",)},
+            }
+        )
+    # chunk-starts whose end never came (the kill!) stay visible
+    for (rid, chunk), starts in open_starts.items():
+        for start in starts:
+            trace_events.append(
+                {
+                    "ph": "i", "name": f"chunk {chunk} (no end)",
+                    "pid": pid_for(rid), "tid": 0,
+                    "ts": round(start, 1), "s": "p",
+                    "args": {"run_id": rid, "chunk_seq": chunk},
+                }
+            )
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# collect (CI forensics)
+
+
+def _default_roots():
+    roots = []
+    obs_dir = os.environ.get("WITT_OBS_DIR")
+    if obs_dir:
+        roots.append(obs_dir)
+    # serve scheduler checkpoint roots (failure dumps land beside the
+    # batch checkpoints) + durable-run temp dirs
+    roots.extend(
+        glob.glob(os.path.join(tempfile.gettempdir(), "witt_serve_ckpt_*"))
+    )
+    roots.append(os.getcwd())
+    return roots
+
+
+def find_recorder_files(roots, max_depth: int = 4):
+    found = []
+    for root in roots:
+        root = os.path.abspath(root)
+        if not os.path.isdir(root):
+            continue
+        base_depth = root.rstrip(os.sep).count(os.sep)
+        for dirpath, dirnames, filenames in os.walk(root):
+            if dirpath.count(os.sep) - base_depth >= max_depth:
+                dirnames[:] = []
+            for name in filenames:
+                if name.startswith("flight_recorder") and name.endswith(
+                    ".jsonl"
+                ):
+                    found.append(os.path.join(dirpath, name))
+    return sorted(set(found))
+
+
+def find_newest_manifest(roots, max_depth: int = 4):
+    """(path, manifest) of the newest checkpoint under the roots, or
+    (None, None)."""
+    from wittgenstein_tpu.engine.checkpoint import read_manifest
+
+    newest, newest_mtime = None, -1.0
+    for root in roots:
+        root = os.path.abspath(root)
+        if not os.path.isdir(root):
+            continue
+        base_depth = root.rstrip(os.sep).count(os.sep)
+        for dirpath, dirnames, filenames in os.walk(root):
+            if dirpath.count(os.sep) - base_depth >= max_depth:
+                dirnames[:] = []
+            for name in filenames:
+                if name.startswith("ckpt_") and name.endswith(".npz"):
+                    p = os.path.join(dirpath, name)
+                    try:
+                        mt = os.path.getmtime(p)
+                    except OSError:
+                        continue
+                    if mt > newest_mtime:
+                        newest, newest_mtime = p, mt
+    if newest is None:
+        return None, None
+    try:
+        return newest, read_manifest(newest)
+    except Exception:  # noqa: BLE001 — a corrupt ckpt is itself evidence
+        return newest, None
+
+
+def collect(out_dir, roots):
+    os.makedirs(out_dir, exist_ok=True)
+    dumps = find_recorder_files(roots)
+    copied = []
+    for i, src in enumerate(dumps):
+        dst = os.path.join(out_dir, f"{i:02d}_{os.path.basename(src)}")
+        if os.path.abspath(src) == os.path.abspath(dst):
+            copied.append(dst)
+            continue
+        try:
+            shutil.copy2(src, dst)
+            copied.append(dst)
+        except OSError:
+            continue
+    ckpt_path, manifest = find_newest_manifest(roots)
+    report = {
+        "roots": [os.path.abspath(r) for r in roots],
+        "recorder_files": dumps,
+        "newest_checkpoint": ckpt_path,
+    }
+    if manifest is not None:
+        with open(
+            os.path.join(out_dir, "newest_checkpoint_manifest.json"), "w"
+        ) as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+    events = load_events(copied)
+    with open(os.path.join(out_dir, "timeline.txt"), "w") as f:
+        f.write(render_timeline(events))
+    report["events"] = len(events)
+    report["runs"] = run_ids(events)
+    with open(os.path.join(out_dir, "collect_report.json"), "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs_query", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    for name in ("timeline", "trace", "runs"):
+        sp = sub.add_parser(name)
+        sp.add_argument("dumps", nargs="+", help="flight-recorder JSONL files")
+        sp.add_argument("--run", help="restrict to one run_id")
+        if name == "trace":
+            sp.add_argument("-o", "--out", required=True)
+
+    cp = sub.add_parser("collect")
+    cp.add_argument("out_dir")
+    cp.add_argument("roots", nargs="*", help="directories to sweep")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "collect":
+        report = collect(args.out_dir, args.roots or _default_roots())
+        print(
+            f"collected {len(report['recorder_files'])} recorder file(s), "
+            f"{report['events']} event(s), "
+            f"newest checkpoint: {report['newest_checkpoint']}"
+        )
+        return 0
+
+    events = load_events(args.dumps, run_id=args.run)
+    if args.cmd == "timeline":
+        sys.stdout.write(render_timeline(events))
+        return 0
+    if args.cmd == "runs":
+        print(json.dumps(run_ids(events), indent=2, sort_keys=True))
+        return 0
+    # trace
+    from wittgenstein_tpu.telemetry.trace import validate_chrome_trace
+
+    doc = to_chrome_trace(events)
+    validate_chrome_trace(doc)
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    print(f"wrote {len(doc['traceEvents'])} trace events to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

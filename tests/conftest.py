@@ -56,8 +56,13 @@ import pytest  # noqa: E402
 # there.  1800 keeps the gate armed against silent growth while being
 # attainable on a single core; CI sets WITT_FAST_BUDGET_S=0 and relies
 # on its own job timeout.
+# r12 recalibration: the suite grew ~420 → 646 tests across the serving,
+# density and observability PRs and the warm single-core sum now measures
+# ~1980 s — over the r6 budget even before this PR (which adds 17 s).
+# 2400 restores the same ~1.2x single-core headroom r6 chose; the gate
+# stays armed against the next silent 43-minute drift.
 try:
-    FAST_BUDGET_S = float(os.environ.get("WITT_FAST_BUDGET_S", "1800"))
+    FAST_BUDGET_S = float(os.environ.get("WITT_FAST_BUDGET_S", "2400"))
 except ValueError:
     raise SystemExit(
         f"WITT_FAST_BUDGET_S={os.environ['WITT_FAST_BUDGET_S']!r} must be "

@@ -33,6 +33,7 @@ from wittgenstein_tpu.runtime import (
     Supervisor,
     WatchdogPolicy,
     WatchdogTimeoutError,
+    WatchdogWorker,
     classify,
     run_with_deadline,
     stable_run_key,
@@ -116,6 +117,74 @@ class TestWatchdog:
 
         with pytest.raises(ValueError, match="inner"):
             run_with_deadline(boom, 5.0, "chunk")
+
+
+class TestWatchdogWorker:
+    """The persistent-worker watchdog (the thread-leak fix): one thread
+    serves every guarded call of a run and is joined on close()."""
+
+    def test_one_thread_reused_across_calls(self):
+        w = WatchdogWorker()
+        names = set()
+        for _ in range(5):
+            assert w.call(threading.current_thread, 5.0, "chunk").ident
+            names.add(w.call(lambda: threading.get_ident(), 5.0, "chunk"))
+        assert len(names) == 1, "worker thread churned between calls"
+        assert w.close()
+
+    def test_close_joins_thread(self):
+        before = threading.active_count()
+        w = WatchdogWorker()
+        assert w.call(lambda: 1, 5.0, "chunk") == 1
+        assert w.close()
+        assert threading.active_count() == before
+
+    def test_hung_worker_abandoned_never_reused(self):
+        ev = threading.Event()
+        w = WatchdogWorker()
+        with pytest.raises(WatchdogTimeoutError):
+            w.call(lambda: ev.wait(30), 0.05, "chunk")
+        assert w.hung
+        with pytest.raises(RuntimeError, match="hung"):
+            w.call(lambda: 2, 5.0, "chunk")
+        assert w.close() is False  # abandoned, not joined
+        # once the stuck call returns, the pre-queued sentinel lets the
+        # abandoned thread exit — the leak lasts only as long as the hang
+        th = w._thread
+        ev.set()
+        if th is not None:
+            th.join(5.0)
+            assert not th.is_alive()
+
+    def test_thread_count_stable_across_10_chunk_supervised_run(self):
+        """The satellite regression: a watchdog-armed 10-chunk run holds
+        at most ONE extra thread while running and zero afterwards (the
+        old per-chunk spawn churned a thread per chunk and left the last
+        one unjoined)."""
+        baseline = threading.active_count()
+        during = []
+
+        rep = Supervisor(
+            toy_chunk, toy_state(), n_chunks=10,
+            watchdog=WatchdogPolicy(
+                chunk_deadline_s=30.0, compile_deadline_s=30.0
+            ),
+            heartbeat=lambda i, dt: during.append(threading.active_count()),
+        ).run()
+        assert rep.ok and rep.chunks_done == 10
+        assert max(during) <= baseline + 1, (
+            f"watchdog churned threads: baseline={baseline}, "
+            f"during={during}"
+        )
+        deadline = time.monotonic() + 5.0
+        while (
+            threading.active_count() > baseline
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert threading.active_count() == baseline, (
+            "watchdog worker outlived its run"
+        )
 
 
 class TestSupervisorLoop:

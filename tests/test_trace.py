@@ -1,0 +1,151 @@
+"""SpanTracer contract tests: Chrome trace-event schema round-trip,
+nested scopes, and run-id correlation (the obs-spine join point —
+a SpanTracer trace must carry the same ids as the flight recorder and
+run records so external tools join them on run_id)."""
+
+import json
+import threading
+
+from wittgenstein_tpu.obs import TraceContext
+from wittgenstein_tpu.telemetry.trace import (
+    SpanTracer,
+    maybe_span,
+    validate_chrome_trace,
+)
+
+
+def _events(tracer, ph=None, name=None):
+    evs = tracer.to_json()["traceEvents"]
+    if ph is not None:
+        evs = [e for e in evs if e["ph"] == ph]
+    if name is not None:
+        evs = [e for e in evs if e["name"] == name]
+    return evs
+
+
+class TestChromeSchema:
+    def test_write_round_trip_validates(self, tmp_path):
+        tracer = SpanTracer("roundtrip")
+        with tracer.span("compile", nodes=64):
+            pass
+        tracer.instant("marker", chunk=0)
+        path = tracer.write(str(tmp_path / "trace.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        validate_chrome_trace(doc)
+        assert doc["displayTimeUnit"] == "ms"
+        # the JSON-file round trip preserves every event verbatim
+        assert doc["traceEvents"] == tracer.to_json()["traceEvents"]
+
+    def test_complete_events_have_ts_and_dur(self):
+        tracer = SpanTracer()
+        with tracer.span("work"):
+            pass
+        (span,) = _events(tracer, ph="X")
+        assert span["ts"] >= 0.0 and span["dur"] >= 0.0
+        assert span["name"] == "work"
+
+    def test_process_name_metadata_first(self):
+        tracer = SpanTracer("my-proc")
+        meta = tracer.to_json()["traceEvents"][0]
+        assert meta["ph"] == "M" and meta["name"] == "process_name"
+        assert meta["args"]["name"] == "my-proc"
+
+    def test_validator_rejects_malformed(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            validate_chrome_trace({"events": []})
+        with pytest.raises(ValueError):
+            validate_chrome_trace({"traceEvents": [{"ph": "X"}]})
+        with pytest.raises(ValueError):
+            # complete event without ts/dur
+            validate_chrome_trace(
+                {"traceEvents": [{"ph": "X", "name": "x"}]}
+            )
+
+
+class TestNesting:
+    def test_nested_scopes_enclose(self):
+        tracer = SpanTracer()
+        with tracer.span("outer"):
+            with tracer.span("inner"):
+                pass
+        inner = _events(tracer, ph="X", name="inner")[0]
+        outer = _events(tracer, ph="X", name="outer")[0]
+        # same lane, and the outer duration encloses the inner one
+        assert inner["tid"] == outer["tid"]
+        assert outer["ts"] <= inner["ts"]
+        assert outer["ts"] + outer["dur"] >= inner["ts"] + inner["dur"]
+
+    def test_span_records_even_on_exception(self):
+        tracer = SpanTracer()
+        try:
+            with tracer.span("doomed"):
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+        assert _events(tracer, ph="X", name="doomed")
+
+    def test_threads_get_distinct_tids(self):
+        tracer = SpanTracer()
+
+        def work():
+            with tracer.span("thread-span"):
+                pass
+
+        t = threading.Thread(target=work)
+        t.start()
+        t.join()
+        with tracer.span("main-span"):
+            pass
+        tids = {e["tid"] for e in _events(tracer, ph="X")}
+        assert len(tids) == 2
+
+    def test_maybe_span_noop_without_tracer(self):
+        with maybe_span(None, "ignored"):
+            pass  # must simply not raise
+        tracer = SpanTracer()
+        with maybe_span(tracer, "real"):
+            pass
+        assert _events(tracer, ph="X", name="real")
+
+
+class TestCorrelation:
+    def test_ctx_ids_on_every_span_and_instant(self):
+        ctx = TraceContext(run_id="run-test", job_id="j1", tenant_id="acme")
+        tracer = SpanTracer(ctx=ctx)
+        with tracer.span("chunk", index=3):
+            pass
+        tracer.instant("marker")
+        span = _events(tracer, ph="X")[0]
+        inst = _events(tracer, ph="i")[0]
+        for ev in (span, inst):
+            assert ev["args"]["run_id"] == "run-test"
+            assert ev["args"]["job_id"] == "j1"
+            assert ev["args"]["tenant_id"] == "acme"
+        # caller args survive the merge (and win on collision)
+        assert span["args"]["index"] == 3
+
+    def test_trace_context_metadata_event(self):
+        tracer = SpanTracer()
+        tracer.set_context({"run_id": "run-meta"})
+        metas = _events(tracer, ph="M", name="trace_context")
+        assert metas and metas[0]["args"] == {"run_id": "run-meta"}
+        # ids attach even in a span-free trace — and to later spans
+        with tracer.span("later"):
+            pass
+        assert _events(tracer, ph="X")[0]["args"]["run_id"] == "run-meta"
+
+    def test_caller_args_win_over_ctx(self):
+        tracer = SpanTracer(ctx={"run_id": "ctx-run"})
+        with tracer.span("s", run_id="explicit"):
+            pass
+        assert _events(tracer, ph="X")[0]["args"]["run_id"] == "explicit"
+
+    def test_uncontexted_tracer_unchanged(self):
+        tracer = SpanTracer()
+        with tracer.span("plain"):
+            pass
+        assert "args" not in _events(tracer, ph="X")[0]
+        assert not _events(tracer, ph="M", name="trace_context")

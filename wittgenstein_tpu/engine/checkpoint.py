@@ -141,6 +141,23 @@ def _sidecar_signature(state: Any) -> Dict[str, Optional[str]]:
     return sig
 
 
+def manifest_trace(manifest: Optional[dict]) -> dict:
+    """The correlation ids of a manifest: its explicit ``trace`` block
+    when present, else the run_id/job_id/tenant_id keys of its meta
+    (how the supervisor stamps them).  Empty dict when untraced."""
+    if not manifest:
+        return {}
+    block = manifest.get("trace")
+    if block:
+        return dict(block)
+    meta = manifest.get("meta") or {}
+    return {
+        k: meta[k]
+        for k in ("run_id", "job_id", "tenant_id")
+        if meta.get(k) is not None
+    }
+
+
 def save_state(state: Any, dest: str, meta: Optional[dict] = None) -> dict:
     """Write a state pytree to `dest` (.npz), keyed by tree path.
 
@@ -169,6 +186,14 @@ def save_state(state: Any, dest: str, meta: Optional[dict] = None) -> dict:
         "meta": dict(meta or {}),
         "created_unix": time.time(),
     }
+    # first-class trace block: the obs correlation ids (run_id / job_id
+    # / tenant_id) the supervisor stamps into meta, surfaced so ledger
+    # tooling (scripts/obs_query.py) can join checkpoints to flight
+    # recorder events without knowing the meta layout.  Absent when the
+    # writer carried no trace context (format stays 2 — additive key).
+    trace = manifest_trace(manifest)
+    if trace:
+        manifest["trace"] = trace
     arrays[MANIFEST_KEY] = np.asarray(json.dumps(manifest))
     # stream straight to a temp file (savez appends .npz when missing),
     # then atomically replace — never a torn checkpoint, no in-RAM copy;

@@ -1,0 +1,39 @@
+"""wittgenstein_tpu.obs — the correlated observability spine.
+
+One TraceContext (run_id / job_id / tenant_id / chunk_seq) minted at
+serve admission or bench entry and threaded through the scheduler, the
+supervisor, checkpoint manifests, SpanTracer spans, and serve metrics;
+one FlightRecorder ring of structured events replayable by
+scripts/obs_query.py; per-tenant attribution sliced from the packed
+replica axis.  Host-side only — sim state is bit-identical with all of
+it armed.  See docs/observability.md for the id-join map.
+"""
+
+from .attribution import batch_attribution, replica_rows
+from .context import TraceContext, mint_context, new_run_id
+from .recorder import (
+    DUMP_BASENAME,
+    ENV_DIR,
+    LIVE_BASENAME,
+    FlightRecorder,
+    failure_dump_paths,
+    get_recorder,
+    read_events,
+    reset_default_recorder,
+)
+
+__all__ = [
+    "TraceContext",
+    "mint_context",
+    "new_run_id",
+    "FlightRecorder",
+    "get_recorder",
+    "reset_default_recorder",
+    "read_events",
+    "failure_dump_paths",
+    "batch_attribution",
+    "replica_rows",
+    "LIVE_BASENAME",
+    "DUMP_BASENAME",
+    "ENV_DIR",
+]

@@ -16,7 +16,7 @@ from .errors import (
     WatchdogTimeoutError,
     classify,
 )
-from .policy import DegradePolicy, RetryPolicy, WatchdogPolicy
+from .policy import DegradePolicy, RetryPolicy, WatchdogPolicy, WatchdogWorker
 from .supervisor import (
     RunReport,
     Supervisor,
@@ -40,6 +40,7 @@ __all__ = [
     "TransientRunError",
     "WatchdogPolicy",
     "WatchdogTimeoutError",
+    "WatchdogWorker",
     "chunk_time_histogram",
     "classify",
     "run_with_deadline",

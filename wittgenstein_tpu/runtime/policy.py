@@ -1,7 +1,8 @@
-"""Supervisor policies: retry/backoff, watchdog deadlines, degradation.
+"""Supervisor policies: retry/backoff, watchdog deadlines, degradation —
+plus the WatchdogWorker that executes guarded calls.
 
-All three are frozen dataclasses so they hash/compare cleanly and can be
-stamped into run provenance.  Backoff jitter is DETERMINISTIC (hashed
+The policies are frozen dataclasses so they hash/compare cleanly and can
+be stamped into run provenance.  Backoff jitter is DETERMINISTIC (hashed
 from seed + attempt) — a resumed supervisor replays the same delays,
 keeping kill-and-resume runs reproducible end to end, and tests can pin
 exact delay sequences without mocking random.
@@ -10,7 +11,12 @@ exact delay sequences without mocking random.
 from __future__ import annotations
 
 import hashlib
+import queue
+import threading
 from dataclasses import dataclass
+from typing import Any, Callable
+
+from .errors import WatchdogTimeoutError
 
 
 @dataclass(frozen=True)
@@ -56,6 +62,90 @@ class WatchdogPolicy:
 
     chunk_deadline_s: float = 180.0
     compile_deadline_s: float = 780.0
+
+
+class WatchdogWorker:
+    """Persistent deadline-guarded executor: ONE worker thread reused
+    across every guarded call of a run, joined on completion.
+
+    This fixes the documented watchdog thread leak: the old
+    run_with_deadline spawned a fresh daemon thread per chunk, so a
+    watchdog-armed N-chunk run churned N threads and a completed run
+    still had its last worker unaccounted for.  Here the same thread
+    serves every chunk and ``close()`` joins it when the run finishes —
+    thread count is stable across an arbitrarily long supervised run
+    (pinned by a tier-1 regression test).
+
+    The one unfixable case remains unfixable: Python cannot cancel a
+    call that truly hangs inside a device tunnel (r3/r4 lesson).  A
+    deadline miss marks the worker ``hung``; it is abandoned (daemonic,
+    never reused — a late result cannot be mistaken for a fresh one
+    because the whole worker, result queue included, is discarded) and
+    the caller creates a replacement.  Actually killing the hang stays a
+    process-level supervisor's job (scripts/tpu_campaign.py).
+    """
+
+    def __init__(self, name: str = "witt-watchdog"):
+        self._name = name
+        self._requests: "queue.Queue" = queue.Queue()
+        self._results: "queue.Queue" = queue.Queue()
+        self._thread: threading.Thread | None = None
+        self.hung = False
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name=self._name
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            fn = self._requests.get()
+            if fn is None:
+                return
+            try:
+                self._results.put(("ok", fn()))
+            except BaseException as e:  # noqa: BLE001 — forwarded to caller
+                self._results.put(("err", e))
+
+    def call(self, fn: Callable[[], Any], deadline_s: float, phase: str):
+        """Run fn() on the worker with a deadline; raise
+        WatchdogTimeoutError(phase) on a miss (and mark the worker hung
+        — callers must discard it and build a fresh one)."""
+        if self.hung:
+            raise RuntimeError(
+                f"WatchdogWorker {self._name!r} is hung; build a new one"
+            )
+        self._ensure_thread()
+        self._requests.put(fn)
+        try:
+            status, payload = self._results.get(timeout=deadline_s)
+        except queue.Empty:
+            self.hung = True
+            # pre-queue the shutdown sentinel: if the stuck call ever
+            # returns, the abandoned worker exits instead of parking on
+            # the request queue forever — the leak lasts exactly as long
+            # as the hang itself
+            self._requests.put(None)
+            raise WatchdogTimeoutError(phase, deadline_s) from None
+        if status == "err":
+            raise payload
+        return payload
+
+    def close(self, timeout_s: float = 5.0) -> bool:
+        """Join the worker thread (call on run completion).  Returns
+        True when the thread is gone; a hung worker is abandoned
+        immediately (returns False) rather than blocking the caller."""
+        th = self._thread
+        self._thread = None
+        if th is None or not th.is_alive():
+            return True
+        if self.hung:
+            return False
+        self._requests.put(None)
+        th.join(timeout_s)
+        return not th.is_alive()
 
 
 @dataclass(frozen=True)

@@ -15,14 +15,16 @@ with:
   (ResumeMismatchError); kill-and-resume is bit-identical to an
   uninterrupted run — including telemetry counters and fault side-cars
   — because resume replays the exact remaining chunk schedule;
-- **watchdog**: each chunk executes in a worker thread with a deadline
-  (the first chunk of a cold process gets the compile allowance on
-  top).  A miss raises WatchdogTimeoutError rather than waiting forever
-  on a dead tunnel.  Caveat: Python cannot cancel a hung device call —
-  the worker thread leaks and the supervisor stops issuing work;
-  actually killing the process is the job of a process-level supervisor
-  (scripts/tpu_campaign.py), because killing mid-device-call wedges the
-  tunneled worker (r3/r4 lesson);
+- **watchdog**: each chunk executes on ONE persistent WatchdogWorker
+  thread with a deadline (the first chunk of a cold process gets the
+  compile allowance on top); the worker is reused across chunks and
+  joined when the run finishes, so thread count is stable across a
+  supervised run.  A miss raises WatchdogTimeoutError rather than
+  waiting forever on a dead tunnel.  Caveat: Python cannot cancel a
+  hung device call — a worker whose call truly hangs is abandoned (and
+  replaced); actually killing the process is the job of a process-level
+  supervisor (scripts/tpu_campaign.py), because killing mid-device-call
+  wedges the tunneled worker (r3/r4 lesson);
 - **retry with backoff**: transient failures (classify()) replay
   deterministically from the last host ANCHOR — a numpy snapshot taken
   at checkpoint cadence — so retried chunks produce the exact bytes a
@@ -34,12 +36,20 @@ with:
   into provenance — a CPU tail can never masquerade as a TPU number;
 - **budget/cap partial stops**: budget_s / max_chunks_this_run exceeded
   between chunks -> checkpoint now, return RunReport(ok=False) — the
-  next invocation resumes where this one stopped.
+  next invocation resumes where this one stopped;
+- **observability spine** (obs.*): a TraceContext (run_id / job_id /
+  tenant_id) rides provenance, checkpoint-manifest meta, tracer spans,
+  and the FlightRecorder event stream.  The run_id SURVIVES kill +
+  resume: _save stamps it into the manifest and _resume adopts the
+  stored id, so the victim process and the resume process emit one
+  joinable run.  On any typed runtime failure the recorder ring is
+  dumped atomically beside the checkpoints (and under $WITT_OBS_DIR) —
+  the per-run black box scripts/obs_query.py replays.  All host-side:
+  sim state stays bit-identical with the recorder armed.
 """
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional
@@ -47,6 +57,7 @@ from typing import Any, Callable, List, Optional
 import numpy as np
 
 from ..engine.checkpoint import CheckpointManager
+from ..obs import FlightRecorder, TraceContext, failure_dump_paths, get_recorder, mint_context
 from .errors import (
     DurableRunError,
     FatalRunError,
@@ -55,7 +66,7 @@ from .errors import (
     WatchdogTimeoutError,
     classify,
 )
-from .policy import DegradePolicy, RetryPolicy, WatchdogPolicy
+from .policy import DegradePolicy, RetryPolicy, WatchdogPolicy, WatchdogWorker
 
 
 def _sync(state: Any) -> None:
@@ -71,26 +82,18 @@ def _sync(state: Any) -> None:
 
 
 def run_with_deadline(fn: Callable[[], Any], deadline_s: float, phase: str):
-    """Run fn() in a worker thread with a deadline; raise
-    WatchdogTimeoutError(phase) on a miss.  The thread is daemonic and
-    LEAKS if fn truly hangs (an uncancellable device call) — callers
-    that need the hang actually killed must supervise at process level."""
-    box: dict = {}
-
-    def worker():
-        try:
-            box["out"] = fn()
-        except BaseException as e:  # noqa: BLE001 — forwarded to caller
-            box["err"] = e
-
-    th = threading.Thread(target=worker, daemon=True, name=f"witt-{phase}")
-    th.start()
-    th.join(deadline_s)
-    if th.is_alive():
-        raise WatchdogTimeoutError(phase, deadline_s)
-    if "err" in box:
-        raise box["err"]
-    return box["out"]
+    """One-shot deadline guard (compat shim over policy.WatchdogWorker).
+    Raises WatchdogTimeoutError(phase) on a miss.  Unlike the original
+    per-call daemon thread, a COMPLETED call's worker is joined before
+    returning; only a call that truly hangs (an uncancellable device
+    call) still abandons its thread — callers that need the hang
+    actually killed must supervise at process level.  Loop callers
+    (Supervisor) hold one WatchdogWorker across calls instead."""
+    worker = WatchdogWorker(name=f"witt-{phase}")
+    try:
+        return worker.call(fn, deadline_s, phase)
+    finally:
+        worker.close()
 
 
 # per-chunk wall-time histogram buckets (seconds): the interesting
@@ -178,6 +181,8 @@ class Supervisor:
         sleep: Callable[[float], None] = time.sleep,
         consume_template: bool = False,
         tracer: Any = None,
+        ctx: Optional[TraceContext] = None,
+        recorder: Optional[FlightRecorder] = None,
     ):
         if n_chunks < 1:
             raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
@@ -209,6 +214,12 @@ class Supervisor:
         # optional telemetry.trace.SpanTracer: chunk spans + instants
         # for retry/degrade/watchdog events land in the Chrome trace
         self.tracer = tracer
+        # trace context: minted lazily at run() if the caller didn't
+        # pass one AND no checkpoint supplies one (_resume adopts the
+        # stored run_id so kill+resume stays one run)
+        self.ctx = ctx
+        self.recorder = get_recorder() if recorder is None else recorder
+        self._wd_worker: Optional[WatchdogWorker] = None
         self._first_call_done = False
         self._degraded = False
 
@@ -258,9 +269,48 @@ class Supervisor:
         if not self._first_call_done:
             deadline += self.watchdog.compile_deadline_s
             phase = "compile+chunk"
-        out = run_with_deadline(call, deadline, phase)
+        # one persistent worker across chunks (closed at run() end); a
+        # hung worker is discarded and replaced — see WatchdogWorker
+        if self._wd_worker is None or self._wd_worker.hung:
+            self._wd_worker = WatchdogWorker()
+        out = self._wd_worker.call(call, deadline, phase)
         self._first_call_done = True
         return out
+
+    def _close_watchdog(self) -> None:
+        if self._wd_worker is not None:
+            self._wd_worker.close()
+            self._wd_worker = None
+
+    # -- observability ---------------------------------------------------
+
+    def _record(self, kind: str, chunk: Optional[int] = None, **fields) -> None:
+        if self.recorder is None:
+            return
+        ctx = self.ctx
+        if ctx is not None and chunk is not None:
+            ctx = ctx.child(chunk_seq=chunk)
+        elif chunk is not None:
+            fields.setdefault("chunk_seq", chunk)
+        self.recorder.record(kind, ctx=ctx, **fields)
+
+    @staticmethod
+    def _tick_hwms(state: Any) -> dict:
+        """Host-side read of the telemetry loop counters / high-water
+        marks for the chunk-end event.  Read-only numpy views of an
+        already-synced state — never feeds back into the sim."""
+        tele = getattr(state, "tele", None)
+        if tele is None or not hasattr(tele, "ticks"):
+            return {}
+        try:
+            return {
+                "ticks": int(np.asarray(tele.ticks).sum()),
+                "jumps": int(np.asarray(tele.jumps).sum()),
+                "wheel_fill_hwm": int(np.asarray(tele.wheel_fill_hwm).max()),
+                "ovf_hwm": int(np.asarray(tele.ovf_hwm).max()),
+            }
+        except (TypeError, ValueError, AttributeError):
+            return {}
 
     # -- resume ---------------------------------------------------------
 
@@ -316,6 +366,20 @@ class Supervisor:
                 f"checkpoint step {step} exceeds this run's "
                 f"n_chunks={self.n_chunks}"
             )
+        # adopt the checkpointed run identity: the ledger's run_id
+        # belongs to the RUN, not the process, so a resume after SIGKILL
+        # keeps emitting under the id the victim minted — obs_query then
+        # reconstructs one timeline across both processes
+        saved_run_id = meta.get("run_id")
+        if saved_run_id:
+            if self.ctx is None:
+                self.ctx = TraceContext(
+                    run_id=saved_run_id,
+                    job_id=meta.get("job_id"),
+                    tenant_id=meta.get("tenant_id"),
+                )
+            elif self.ctx.run_id != saved_run_id:
+                self.ctx = self.ctx.child(run_id=saved_run_id)
         prior = list(meta.get("chunk_seconds", []))
         return self._place(self._snapshot(state)), step, step, prior
 
@@ -329,12 +393,30 @@ class Supervisor:
             "chunk_seconds": [round(t, 4) for t in times_all],
             "degraded": self._degraded,
         }
+        if self.ctx is not None:
+            # trace ids into the manifest meta (checkpoint.save_state
+            # surfaces them as manifest["trace"]) — the join key a
+            # resume adopts and obs_query correlates on
+            meta.setdefault("run_id", self.ctx.run_id)
+            if self.ctx.job_id is not None:
+                meta.setdefault("job_id", self.ctx.job_id)
+            if self.ctx.tenant_id is not None:
+                meta.setdefault("tenant_id", self.ctx.tenant_id)
         self.manager.save(state, step, meta=meta)
+        self._record("checkpoint", step=step, dir=self.manager.directory)
 
     # -- the loop -------------------------------------------------------
 
     def run(self) -> RunReport:
         state, start_chunk, resumed_from, prior_times = self._resume()
+        if self.ctx is None:
+            # no caller-minted context and no checkpoint to adopt from:
+            # this supervisor IS the run's entry point
+            self.ctx = mint_context("run")
+        if resumed_from is not None:
+            self._record(
+                "resume", step=resumed_from, run_key=self.run_key
+            )
         anchor = self._snapshot(state) if self._needs_anchor else None
         anchor_chunk = start_chunk
         times: List[float] = []  # this run's completed chunks, in order
@@ -362,80 +444,130 @@ class Supervisor:
                 "n_chunks": self.n_chunks,
                 "chunks_done": done,
                 "chunk_time_hist": chunk_time_histogram(times),
+                **(self.ctx.ids() if self.ctx is not None else {}),
             }
 
-        while i < self.n_chunks:
-            over_budget = time.perf_counter() - t_start > self.budget_s
-            over_cap = (
-                self.max_chunks_this_run is not None
-                and len(times) >= self.max_chunks_this_run
-            )
-            if over_budget or over_cap:
-                # controlled partial stop: checkpoint NOW (even
-                # off-cadence — resumability beats cadence) and report
-                if self.manager is not None and i > anchor_chunk:
-                    self._save(state, i, prior_times + times)
-                    checkpoints += 1
-                return RunReport(
-                    state, False, times, provenance(i)
+        try:
+            while i < self.n_chunks:
+                over_budget = time.perf_counter() - t_start > self.budget_s
+                over_cap = (
+                    self.max_chunks_this_run is not None
+                    and len(times) >= self.max_chunks_this_run
                 )
-            try:
-                t1 = time.perf_counter()
-                state = self._run_chunk(state)
-                dt = time.perf_counter() - t1
-                if self.tracer is not None:
-                    self.tracer.add_span(
-                        "chunk", self.tracer.now_us() - dt * 1e6, dt * 1e6,
-                        chunk=i, degraded=self._degraded,
+                if over_budget or over_cap:
+                    # controlled partial stop: checkpoint NOW (even
+                    # off-cadence — resumability beats cadence) and report
+                    if self.manager is not None and i > anchor_chunk:
+                        self._save(state, i, prior_times + times)
+                        checkpoints += 1
+                    self._record(
+                        "partial-stop", chunk=i,
+                        reason="budget" if over_budget else "chunk-cap",
+                        chunks_done=i,
                     )
-            except BaseException as e:  # noqa: BLE001 — classified below
-                kind = classify(e)
-                if isinstance(e, WatchdogTimeoutError):
-                    watchdog_timeouts += 1
-                if self.tracer is not None:
-                    self.tracer.instant(
-                        "chunk-failed", chunk=i, kind=kind,
-                        error=type(e).__name__,
+                    return RunReport(
+                        state, False, times, provenance(i)
                     )
-                if kind == "fatal":
-                    raise
-                fail_streak += 1
-                retries_total += 1
-                if fail_streak >= self.retry.max_attempts:
-                    raise RetriesExhaustedError(fail_streak, e) from e
-                if (
-                    kind == "device_lost"
-                    and self.degrade is not None
-                    and self.degrade.cpu_fallback
-                    and not self._degraded
-                ):
-                    self._degraded = True
-                    degraded_at = i
-                    self._first_call_done = False  # CPU gets a compile
+                try:
+                    self._record("chunk-start", chunk=i)
+                    t1 = time.perf_counter()
+                    state = self._run_chunk(state)
+                    dt = time.perf_counter() - t1
+                    self._record(
+                        "chunk-end", chunk=i, seconds=round(dt, 4),
+                        degraded=self._degraded or None,
+                        **self._tick_hwms(state),
+                    )
                     if self.tracer is not None:
-                        self.tracer.instant("degraded-to-cpu", chunk=i)
-                self.sleep(self.retry.delay_s(fail_streak - 1))
-                # replay deterministically from the last anchor: the
-                # chunks between anchor_chunk and i re-run and produce
-                # the exact bytes the failed timeline would have
-                state = self._place(anchor)
-                times = times[: anchor_chunk - start_chunk]
-                i = anchor_chunk
-                continue
-            fail_streak = 0
-            times.append(dt)
-            if self.heartbeat is not None:
-                self.heartbeat(i, dt)
-            i += 1
-            at_cadence = (i - start_chunk) % self.checkpoint_every == 0
-            if at_cadence or i == self.n_chunks:
-                if self.manager is not None:
-                    self._save(state, i, prior_times + times)
-                    checkpoints += 1
-                if self._needs_anchor:
-                    anchor = self._snapshot(state)
-                    anchor_chunk = i
+                        self.tracer.add_span(
+                            "chunk", self.tracer.now_us() - dt * 1e6, dt * 1e6,
+                            chunk=i, degraded=self._degraded,
+                        )
+                except BaseException as e:  # noqa: BLE001 — classified below
+                    kind = classify(e)
+                    if isinstance(e, WatchdogTimeoutError):
+                        watchdog_timeouts += 1
+                        self._record(
+                            "watchdog", chunk=i, phase=e.phase,
+                            deadline_s=e.deadline_s,
+                        )
+                    if self.tracer is not None:
+                        self.tracer.instant(
+                            "chunk-failed", chunk=i, kind=kind,
+                            error=type(e).__name__,
+                        )
+                    if kind == "fatal":
+                        raise
+                    fail_streak += 1
+                    retries_total += 1
+                    if fail_streak >= self.retry.max_attempts:
+                        raise RetriesExhaustedError(fail_streak, e) from e
+                    if (
+                        kind == "device_lost"
+                        and self.degrade is not None
+                        and self.degrade.cpu_fallback
+                        and not self._degraded
+                    ):
+                        self._degraded = True
+                        degraded_at = i
+                        self._first_call_done = False  # CPU gets a compile
+                        self._record("degraded", chunk=i, to="cpu")
+                        if self.tracer is not None:
+                            self.tracer.instant("degraded-to-cpu", chunk=i)
+                    delay = self.retry.delay_s(fail_streak - 1)
+                    self._record(
+                        "retry", chunk=i, error_kind=kind,
+                        error=type(e).__name__, fail_streak=fail_streak,
+                        delay_s=round(delay, 4), replay_from=anchor_chunk,
+                    )
+                    self.sleep(delay)
+                    # replay deterministically from the last anchor: the
+                    # chunks between anchor_chunk and i re-run and produce
+                    # the exact bytes the failed timeline would have
+                    state = self._place(anchor)
+                    times = times[: anchor_chunk - start_chunk]
+                    i = anchor_chunk
+                    continue
+                fail_streak = 0
+                times.append(dt)
+                if self.heartbeat is not None:
+                    self.heartbeat(i, dt)
+                i += 1
+                at_cadence = (i - start_chunk) % self.checkpoint_every == 0
+                if at_cadence or i == self.n_chunks:
+                    if self.manager is not None:
+                        self._save(state, i, prior_times + times)
+                        checkpoints += 1
+                    if self._needs_anchor:
+                        anchor = self._snapshot(state)
+                        anchor_chunk = i
+        except BaseException as e:  # noqa: BLE001 — black-box dump, re-raised
+            self._dump_on_failure(e, chunk=i)
+            raise
+        finally:
+            self._close_watchdog()
+        self._record("run-complete", chunks_done=self.n_chunks)
         return RunReport(state, True, times, provenance(self.n_chunks))
+
+    def _dump_on_failure(self, exc: BaseException, chunk: int) -> None:
+        """The black-box contract: any failure that escapes the retry
+        loop dumps the flight-recorder ring atomically beside the
+        checkpoints (and under $WITT_OBS_DIR if set) before the
+        exception propagates."""
+        if self.recorder is None:
+            return
+        kind = classify(exc)
+        self._record(
+            "failure", chunk=chunk, error_kind=kind,
+            error=type(exc).__name__, message=str(exc)[:500],
+            typed=isinstance(exc, DurableRunError),
+        )
+        ckpt_dir = self.manager.directory if self.manager is not None else None
+        for path in failure_dump_paths(ckpt_dir):
+            try:
+                self.recorder.dump(path)
+            except OSError:
+                pass  # forensics must never mask the real failure
 
     # -- convenience ----------------------------------------------------
 

@@ -204,6 +204,10 @@ class JobSpec:
     sim_ms: int = 1000
     chunk_ms: int = 0
     priority: int = 0
+    # attribution identity only — never part of the compatibility key,
+    # so tenants pack together freely (isolation is accounting, not
+    # placement)
+    tenant: str = "default"
 
     @classmethod
     def from_dict(cls, spec: dict) -> "JobSpec":
@@ -222,6 +226,9 @@ class JobSpec:
                 f"simMs={sim_ms} must be a multiple of chunkMs={chunk_ms}"
             )
         ops = spec.get("faults")
+        tenant = str(spec.get("tenant", spec.get("tenantId", "default")))
+        if not tenant:
+            raise ValueError("tenant must be a non-empty string")
         return cls(
             protocol=protocol,
             params=dict(spec.get("params", {})),
@@ -231,6 +238,7 @@ class JobSpec:
             sim_ms=sim_ms,
             chunk_ms=chunk_ms,
             priority=int(spec.get("priority", 0)),
+            tenant=tenant,
         )
 
 
@@ -261,6 +269,13 @@ class Job:
     exc: Optional[BaseException] = None
     cancel_requested: bool = False
     batch_id: Optional[str] = None
+    # obs spine: run_id is minted at ADMISSION (the earliest moment the
+    # work exists) and joins this job's flight-recorder events, spans,
+    # checkpoint manifests and metrics samples; attribution is the
+    # per-tenant counter slice filled in by the scheduler at batch
+    # boundaries (obs.batch_attribution)
+    run_id: str = ""
+    attribution: Optional[dict] = None
     done_event: threading.Event = dataclasses.field(
         default_factory=threading.Event
     )
@@ -269,6 +284,10 @@ class Job:
         if not self.id:
             self.seq = next(_JOB_SEQ)
             self.id = f"job-{self.seq:06d}"
+        if not self.run_id:
+            from ..obs import new_run_id
+
+            self.run_id = new_run_id("job")
 
     def finish(self, state: JobState, *, result=None, error=None, exc=None):
         self.state = state
@@ -285,6 +304,7 @@ class Job:
         result endpoint so status stays small."""
         out = {
             "id": self.id,
+            "runId": self.run_id,
             "state": self.state.value,
             "kind": self.kind,
             "priority": self.priority,
@@ -298,6 +318,9 @@ class Job:
             out["simMs"] = self.spec.sim_ms
             out["chunkMs"] = self.spec.chunk_ms
             out["seed"] = self.spec.seed
+            out["tenant"] = self.spec.tenant
+        if self.attribution is not None:
+            out["attribution"] = self.attribution
         if self.error:
             out["error"] = self.error
         return out

@@ -12,7 +12,13 @@ signals the batching design is judged by:
     claim, measurable);
   * per-job latency and time-to-first-result quantiles (p50/p99 over a
     bounded reservoir of completed jobs);
-  * preemption/resume counts for the priority-interleaving path.
+  * preemption/resume counts for the priority-interleaving path;
+  * per-tenant attribution (ticks, device-time share, dropped/fault
+    counters — fed by the scheduler's obs.batch_attribution slices)
+    and per-run latency samples labelled {run_id, tenant} over a small
+    bounded window, so an external scraper can join /metrics to the
+    flight-recorder / run-record ledger on run_id without us exporting
+    an unbounded label cardinality.
 
 Rendering goes through telemetry.export.PromText into the server's
 existing /metrics exposition — one text format, one scrape.
@@ -40,6 +46,10 @@ class ServeMetrics:
 
     #: completed-job reservoir bound for the latency quantiles
     WINDOW = 1024
+    #: bounded window of per-run labelled latency samples (cardinality
+    #: guard: /metrics carries the last RUN_WINDOW runs, the full
+    #: ledger lives in the flight recorder / run records)
+    RUN_WINDOW = 32
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -56,6 +66,10 @@ class ServeMetrics:
         self.batch_seconds_total = 0.0
         self._latency_s = deque(maxlen=self.WINDOW)
         self._ttfr_s = deque(maxlen=self.WINDOW)
+        # (run_id, tenant, latency_s) of recently completed jobs
+        self._recent_runs = deque(maxlen=self.RUN_WINDOW)
+        # tenant -> accumulated attribution counters
+        self._tenants: dict = {}
 
     # -- observations --------------------------------------------------
 
@@ -74,9 +88,43 @@ class ServeMetrics:
             elif job.state is JobState.CANCELLED:
                 self.jobs_cancelled += 1
             if job.finished_at and job.submitted_at:
-                self._latency_s.append(job.finished_at - job.submitted_at)
+                lat = job.finished_at - job.submitted_at
+                self._latency_s.append(lat)
+                run_id = getattr(job, "run_id", None)
+                if run_id:
+                    tenant = (
+                        job.spec.tenant if job.spec is not None else "default"
+                    )
+                    self._recent_runs.append((run_id, tenant, lat))
             if job.first_result_at and job.submitted_at:
                 self._ttfr_s.append(job.first_result_at - job.submitted_at)
+
+    def observe_tenant(self, tenant: str, job_attrib: Optional[dict]) -> None:
+        """Fold one completed job's attribution slice into its tenant's
+        running totals (scheduler calls this at batch finalize)."""
+        if not job_attrib:
+            return
+        with self._lock:
+            t = self._tenants.setdefault(
+                tenant,
+                {
+                    "jobs": 0,
+                    "ticks": 0,
+                    "dropped": 0,
+                    "fault_dropped": 0,
+                    "device_time_share_last": 0.0,
+                },
+            )
+            t["jobs"] += 1
+            for src, dst in (
+                ("ticks", "ticks"),
+                ("dropped", "dropped"),
+                ("fault_dropped", "fault_dropped"),
+            ):
+                if job_attrib.get(src) is not None:
+                    t[dst] += job_attrib[src]
+            if job_attrib.get("device_time_share") is not None:
+                t["device_time_share_last"] = job_attrib["device_time_share"]
 
     def observe_batch(
         self, packed: int, capacity: int, seconds: float
@@ -144,6 +192,8 @@ class ServeMetrics:
         if queue_depth is not None:
             out["queue_depth"] = queue_depth
         out.update(self.latency_quantiles())
+        with self._lock:
+            out["tenants"] = {k: dict(v) for k, v in self._tenants.items()}
         return out
 
     def add_prometheus(self, p, queue) -> None:
@@ -186,6 +236,8 @@ class ServeMetrics:
                   "wall seconds spent in batch dispatches", "counter")
             lat = list(self._latency_s)
             ttfr = list(self._ttfr_s)
+            recent = list(self._recent_runs)
+            tenants = {k: dict(v) for k, v in self._tenants.items()}
         for q in (0.5, 0.99):
             p.add("serve_job_latency_seconds", quantile(lat, q),
                   "submit->finish latency of completed jobs", "gauge",
@@ -193,6 +245,29 @@ class ServeMetrics:
             p.add("serve_time_to_first_result_seconds", quantile(ttfr, q),
                   "submit->first progress/result latency", "gauge",
                   {"quantile": str(q)})
+        # per-run samples on the same family: {run_id, tenant} labels
+        # join /metrics to the flight recorder / run records; bounded at
+        # RUN_WINDOW recent runs so label cardinality cannot grow
+        for run_id, tenant, sec in recent:
+            p.add("serve_job_latency_seconds", round(sec, 6),
+                  "submit->finish latency of completed jobs", "gauge",
+                  {"run_id": run_id, "tenant": tenant})
+        for tenant, t in sorted(tenants.items()):
+            labels = {"tenant": tenant}
+            p.add("serve_tenant_jobs_total", t["jobs"],
+                  "completed jobs attributed per tenant", "counter", labels)
+            p.add("serve_tenant_ticks_total", t["ticks"],
+                  "engine loop ticks attributed to the tenant's replica "
+                  "rows", "counter", labels)
+            p.add("serve_tenant_dropped_total", t["dropped"],
+                  "store-overflow drops on the tenant's rows", "counter",
+                  labels)
+            p.add("serve_tenant_fault_dropped_total", t["fault_dropped"],
+                  "fault-lane suppressions on the tenant's rows", "counter",
+                  labels)
+            p.add("serve_tenant_device_time_share", t["device_time_share_last"],
+                  "tenant share of the most recent batch's live row-ticks",
+                  "gauge", labels)
         info = run_cache_info()
         lookups = info["hits"] + info["misses"]
         p.add("serve_compile_cache_hit_ratio",

@@ -53,6 +53,13 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..obs import (
+    FlightRecorder,
+    TraceContext,
+    batch_attribution,
+    get_recorder,
+    mint_context,
+)
 from .jobs import (
     Job,
     JobQueue,
@@ -62,6 +69,15 @@ from .jobs import (
     serve_protocol,
 )
 from .metrics import ServeMetrics
+
+
+def _job_ctx(job: Job) -> TraceContext:
+    """The admission-minted identity of a job as a TraceContext."""
+    return TraceContext(
+        run_id=job.run_id,
+        job_id=job.id,
+        tenant_id=job.spec.tenant if job.spec is not None else None,
+    )
 
 
 def _leaf_signature(state) -> tuple:
@@ -151,6 +167,7 @@ class BatchScheduler:
         telemetry_snapshots: int = 32,
         checkpoint_root: Optional[str] = None,
         auto_start: bool = True,
+        recorder: Optional[FlightRecorder] = None,
     ):
         if max_batch_replicas < 1:
             raise ValueError(
@@ -165,6 +182,10 @@ class BatchScheduler:
             tempfile.gettempdir(), f"witt_serve_ckpt_{os.getpid()}"
         )
         self.auto_start = auto_start
+        # flight recorder: admission / packing / dispatch decisions land
+        # here alongside the supervisor's chunk events (one ring per
+        # process by default, see obs.get_recorder)
+        self.recorder = recorder if recorder is not None else get_recorder()
         self._families: Dict[str, ScenarioFamily] = {}
         self._fam_lock = threading.Lock()
         self._parked: List[_ParkedBatch] = []
@@ -210,11 +231,28 @@ class BatchScheduler:
 
     def submit(self, spec_dict: dict) -> Job:
         """Parse, validate, and enqueue one job (raises ValueError /
-        KeyError on a malformed spec, QueueFullError on backpressure)."""
+        KeyError on a malformed spec, QueueFullError on backpressure).
+        This is where the job's run_id is minted (Job.__post_init__) —
+        the first flight-recorder event of the run is its admission."""
         spec = JobSpec.from_dict(spec_dict)
         job = Job(spec=spec, compat=self.pre_key(spec),
                   priority=spec.priority)
-        self.queue.submit(job, retry_after_s=self.retry_after_s())
+        try:
+            self.queue.submit(job, retry_after_s=self.retry_after_s())
+        except QueueFullError as e:
+            self.recorder.record(
+                "admission-rejected", ctx=_job_ctx(job),
+                protocol=spec.protocol, depth=e.depth,
+                retry_after_s=e.retry_after_s,
+            )
+            raise
+        self.recorder.record(
+            "admission", ctx=_job_ctx(job),
+            protocol=spec.protocol, compat=job.compat,
+            sim_ms=spec.sim_ms, chunk_ms=spec.chunk_ms or None,
+            priority=spec.priority or None,
+            queue_depth=self.queue.depth(),
+        )
         self.metrics.observe_submit()
         if self.auto_start:
             self.start()
@@ -480,12 +518,33 @@ class BatchScheduler:
             j.state = JobState.RUNNING
             j.started_at = now
             j.batch_id = batch_id
+        # the batch gets its own run identity (it IS the device run);
+        # the pack event records the join batch run_id <-> member job
+        # run_ids, so obs_query can walk from any job to its chunks
+        batch_ctx = mint_context("batch")
+        self.recorder.record(
+            "pack", ctx=batch_ctx, batch_id=batch_id,
+            compat=live[0].compat, family_digest=fam.digest,
+            mode="chunked" if fam.chunk_ms else "direct",
+            members=[
+                {
+                    "job_id": j.id,
+                    "run_id": j.run_id,
+                    "tenant": j.spec.tenant,
+                    "replica": i,
+                }
+                for i, j in enumerate(live)
+            ],
+            live_rows=len(live),
+            padding_rows=self.max_batch_replicas - len(live),
+            capacity=self.max_batch_replicas,
+        )
         if fam.chunk_ms:
-            self._start_chunked(batch_id, fam, live, stacked)
+            self._start_chunked(batch_id, fam, live, stacked, batch_ctx)
         else:
-            self._dispatch_direct(batch_id, fam, live, stacked)
+            self._dispatch_direct(batch_id, fam, live, stacked, batch_ctx)
 
-    def _dispatch_direct(self, batch_id, fam, jobs, stacked) -> None:
+    def _dispatch_direct(self, batch_id, fam, jobs, stacked, ctx=None) -> None:
         from ..parallel.replica_shard import sharded_run_stats
 
         t0 = time.monotonic()
@@ -493,6 +552,10 @@ class BatchScheduler:
             out, _stats = sharded_run_stats(fam.net, stacked, fam.sim_ms)
             self._finalize(fam, jobs, out)
         except BaseException as e:  # noqa: BLE001 — device failure
+            self.recorder.record(
+                "batch-failed", ctx=ctx, batch_id=batch_id,
+                error=f"{type(e).__name__}: {e}"[:500],
+            )
             for j in jobs:
                 self._finish_job(
                     j, JobState.FAILED,
@@ -506,7 +569,7 @@ class BatchScheduler:
                 len(jobs), self.max_batch_replicas, dt
             )
 
-    def _start_chunked(self, batch_id, fam, jobs, stacked) -> None:
+    def _start_chunked(self, batch_id, fam, jobs, stacked, ctx=None) -> None:
         from ..parallel.replica_shard import _run_and_reduce
         from ..runtime.supervisor import Supervisor, stable_run_key
 
@@ -524,6 +587,16 @@ class BatchScheduler:
             checkpoint_every=1,
             run_key=stable_run_key(fam.net, stacked, n_chunks, fam.chunk_ms),
             max_chunks_this_run=self.slice_chunks,
+            ctx=ctx,
+            recorder=self.recorder,
+            run_meta={
+                "batch_id": batch_id,
+                "members": [
+                    {"job_id": j.id, "run_id": j.run_id,
+                     "tenant": j.spec.tenant}
+                    for j in jobs
+                ],
+            },
         )
         parked = _ParkedBatch(
             batch_id, fam, jobs, sup, ckpt_dir,
@@ -545,6 +618,13 @@ class BatchScheduler:
         try:
             report = parked.supervisor.run()
         except BaseException as e:  # noqa: BLE001 — supervised failure
+            # the supervisor already recorded + dumped its black box;
+            # this event marks the batch-level consequence
+            self.recorder.record(
+                "batch-failed", ctx=parked.supervisor.ctx,
+                batch_id=parked.batch_id,
+                error=f"{type(e).__name__}: {e}"[:500],
+            )
             for j in parked.jobs:
                 self._finish_job(
                     j, JobState.FAILED,
@@ -567,9 +647,15 @@ class BatchScheduler:
     def _stream_progress(self, parked: _ParkedBatch, stacked) -> None:
         from ..telemetry.export import progress_series
 
+        # live per-tenant attribution at the slice boundary: /w/jobs
+        # shows who is consuming the batch while it runs, not only at
+        # the end
+        attrib = self._attribution(parked.family, parked.jobs, stacked)
         for i, job in enumerate(parked.jobs):
             if job.state is not JobState.RUNNING:
                 continue
+            if attrib is not None:
+                job.attribution = self._job_attribution(attrib, job)
             series = progress_series(stacked, replica=i)
             if series:
                 job.progress = series
@@ -580,9 +666,40 @@ class BatchScheduler:
             self._parked.remove(parked)
         shutil.rmtree(parked.ckpt_dir, ignore_errors=True)
 
+    # -- attribution ----------------------------------------------------
+
+    def _attribution(self, fam, jobs: List[Job], out) -> Optional[dict]:
+        """Per-tenant counter slice of a packed batch (obs module);
+        read-only over the final state, never affects the result
+        digests."""
+        try:
+            return batch_attribution(
+                fam.net,
+                out,
+                [
+                    {"job_id": j.id, "run_id": j.run_id,
+                     "tenant": j.spec.tenant}
+                    for j in jobs
+                ],
+                self.max_batch_replicas,
+            )
+        except (TypeError, ValueError, AttributeError):
+            return None  # attribution must never fail a batch
+
+    @staticmethod
+    def _job_attribution(attrib: dict, job: Job) -> dict:
+        """The one-job status view: this job's row slice, its tenant's
+        aggregate, and the batch totals they reconcile against."""
+        return {
+            "job": attrib["jobs"].get(job.id),
+            "tenant": attrib["tenants"].get(job.spec.tenant),
+            "batch": attrib["batch"],
+        }
+
     def _finalize(self, fam: ScenarioFamily, jobs: List[Job], out) -> None:
         import jax
 
+        attrib = self._attribution(fam, jobs, out)
         for i, job in enumerate(jobs):
             if job.cancel_requested:
                 self._finish_job(job, JobState.CANCELLED)
@@ -590,6 +707,12 @@ class BatchScheduler:
             row = jax.tree_util.tree_map(lambda a, i=i: a[i], out)
             result = self._row_result(fam, row)
             job.progress = result["progress"]
+            if attrib is not None:
+                job.attribution = self._job_attribution(attrib, job)
+                result["attribution"] = job.attribution
+                self.metrics.observe_tenant(
+                    job.spec.tenant, attrib["jobs"].get(job.id)
+                )
             self._finish_job(job, JobState.DONE, result=result)
 
     # -- worker --------------------------------------------------------

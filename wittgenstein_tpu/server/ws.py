@@ -271,6 +271,11 @@ class WServer:
         return Response(
             {
                 "id": job.id,
+                # the obs spine's correlation id, minted at this
+                # admission: join key into flight-recorder events,
+                # checkpoint manifests, and /metrics run samples
+                "runId": job.run_id,
+                "tenant": job.spec.tenant if job.spec else None,
                 "state": job.state.value,
                 "compat": job.compat,
                 "queueDepth": self.jobs.queue.depth(),
@@ -283,7 +288,13 @@ class WServer:
         return {
             "scheduler": self.jobs.status(),
             "jobs": [
-                {"id": j.id, "state": j.state.value, "kind": j.kind}
+                {
+                    "id": j.id,
+                    "runId": j.run_id,
+                    "tenant": j.spec.tenant if j.spec else None,
+                    "state": j.state.value,
+                    "kind": j.kind,
+                }
                 for j in self.jobs.queue.jobs()
             ],
         }

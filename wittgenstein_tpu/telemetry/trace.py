@@ -17,6 +17,14 @@ chrome://tracing, Perfetto and speedscope all open it directly.
 
 Spans nest naturally (same tid, enclosing durations) and are
 threadsafe — each thread gets its own tid lane.
+
+Correlation: construct with ``ctx=`` (an obs.TraceContext, or any
+object with ``.ids() -> dict``, or a plain dict) and every span /
+instant carries the run's correlation ids (run_id / job_id /
+tenant_id) in its args — the same ids the flight recorder, run
+records, checkpoint manifests and serve metrics carry, so a Chrome
+trace joins the rest of the ledger on run_id.  Kept duck-typed so this
+module stays dependency-free.
 """
 
 from __future__ import annotations
@@ -33,10 +41,11 @@ class SpanTracer:
     """Collects complete ("ph": "X") trace events with microsecond
     timestamps relative to tracer construction."""
 
-    def __init__(self, process_name: str = "wittgenstein-tpu"):
+    def __init__(self, process_name: str = "wittgenstein-tpu", ctx=None):
         self._t0 = time.perf_counter()
         self._lock = threading.Lock()
         self._tids = {}  # thread ident -> small stable tid
+        self._ctx_ids: dict = {}
         self.events = [
             {
                 "ph": "M",
@@ -46,6 +55,33 @@ class SpanTracer:
                 "args": {"name": process_name},
             }
         ]
+        if ctx is not None:
+            self.set_context(ctx)
+
+    def set_context(self, ctx) -> None:
+        """Attach correlation ids (obs.TraceContext, any ``.ids()``
+        carrier, or a plain dict): merged into the args of every
+        subsequent span/instant, and emitted once as a metadata event
+        so the ids survive even in a span-free trace."""
+        ids = dict(ctx.ids()) if hasattr(ctx, "ids") else dict(ctx)
+        self._ctx_ids = ids
+        with self._lock:
+            self.events.append(
+                {
+                    "ph": "M",
+                    "name": "trace_context",
+                    "pid": os.getpid(),
+                    "tid": 0,
+                    "args": ids,
+                }
+            )
+
+    def _with_ctx(self, args: dict) -> dict:
+        if not self._ctx_ids:
+            return args
+        merged = dict(self._ctx_ids)
+        merged.update(args)
+        return merged
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
@@ -70,6 +106,7 @@ class SpanTracer:
             "ts": round(start_us, 1),
             "dur": round(dur_us, 1),
         }
+        args = self._with_ctx(args)
         if args:
             ev["args"] = args
         with self._lock:
@@ -93,6 +130,7 @@ class SpanTracer:
             "ts": round(self._now_us(), 1),
             "s": "t",
         }
+        args = self._with_ctx(args)
         if args:
             ev["args"] = args
         with self._lock:
