@@ -8,8 +8,10 @@ recoverable from each round (sims/s, vs_baseline, config, compile/run
 seconds), derives µs/tick where the inputs exist (needs a
 ticks-per-sim census for the round's node count — BUDGET.json carries
 one for its committed config), attaches the BUDGET.json HBM model
-(MiB/replica) as the capacity reference, and emits the whole
-trajectory as JSON.
+(MiB/replica) as the capacity reference, folds in the serving-fleet
+benchmark (BENCH_SERVE.json — sims/s, queue-latency quantiles, wave
+width/speedup, written by scripts/serve_loadgen.py), and emits the
+whole trajectory as JSON.
 
 ``--check`` is the perf-trend gate (tier1.yml): it FAILS when the
 newest round comparable to BENCH_FLOOR.json (same node_count +
@@ -79,6 +81,18 @@ def _extract_record(tail: str):
 def _load_budget(root: str):
     try:
         with open(os.path.join(root, "BUDGET.json")) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _load_serve(root: str):
+    """The serving-fleet benchmark record (BENCH_SERVE.json, written by
+    scripts/serve_loadgen.py): aggregate sims/s, queue-latency
+    quantiles, wave width, wave-vs-serial speedup.  Optional — absent
+    until the serve loadgen has run."""
+    try:
+        with open(os.path.join(root, "BENCH_SERVE.json")) as f:
             return json.load(f)
     except (OSError, json.JSONDecodeError):
         return None
@@ -186,6 +200,7 @@ def build_trend(root: str = ROOT) -> dict:
         "latest_comparable": comp[-1] if comp else None,
         "regressions": regressions,
         "budget": _load_budget(root),
+        "serve": _load_serve(root),
     }
     return trend
 
@@ -220,6 +235,14 @@ def check(trend: dict) -> list:
                 f"{reg['drop_frac']:.1%} (> {REGRESSION_FRAC:.0%}) and the "
                 "newer round is below the floor — undocumented regression"
             )
+    # the serve record gates itself (loadgen exits nonzero); here we
+    # only refuse a committed record that says it failed
+    serve = trend.get("serve")
+    if serve is not None and not serve.get("ok", True):
+        problems.append(
+            "BENCH_SERVE.json records a failed serve benchmark: "
+            + "; ".join(serve.get("failures", ["unknown"]))[:300]
+        )
     return problems
 
 

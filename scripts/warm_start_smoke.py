@@ -1,0 +1,171 @@
+"""Warm-start smoke: a restarted server pays ZERO fresh XLA compiles.
+
+Three child processes against one compile-store directory (ISSUE 13):
+
+  1. COLD  — empty store: the workload (a direct batch + a chunked
+     batch through the serve scheduler) compiles fresh and publishes
+     every program (store puts > 0, compiles > 0);
+  2. WARM  — same store: the identical workload must perform 0 fresh
+     XLA compiles (run-cache "compiles" counter delta == 0, store
+     hits > 0) and produce byte-identical result digests — the
+     zero-compile warm start, counter-asserted across a real process
+     boundary;
+  3. DIRTY — every .bin payload in the store is truncated first: the
+     workload must fall back to fresh compiles (corrupt counted, no
+     crash, digests still identical) — a damaged store costs time,
+     never correctness.
+
+Each child prints one JSON line (counter deltas + digests); the parent
+asserts the contract and exits nonzero on any violation.  CI runs this
+as the tier-1 warm-start step.
+
+Usage: python scripts/warm_start_smoke.py [store_dir]
+       python scripts/warm_start_smoke.py --child <store_dir>   (internal)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+WORKLOAD = [
+    {"protocol": "PingPong", "params": {"node_ct": 32}, "simMs": 80,
+     "seed": 1},
+    {"protocol": "PingPong", "params": {"node_ct": 32}, "simMs": 80,
+     "seed": 2},
+    {"protocol": "PingPong", "params": {"node_ct": 32}, "simMs": 160,
+     "chunkMs": 80, "seed": 3},
+]
+
+
+def child(store_dir: str) -> int:
+    """One 'server process': run the workload, report counter deltas."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from wittgenstein_tpu.parallel.replica_shard import run_cache_info
+    from wittgenstein_tpu.runtime.compile_store import (
+        compile_store_counters,
+        set_compile_store,
+    )
+    from wittgenstein_tpu.serve import BatchScheduler, JobState
+
+    set_compile_store(store_dir)
+    cache0 = dict(run_cache_info())
+    store0 = compile_store_counters()
+
+    sched = BatchScheduler(auto_start=False, max_batch_replicas=4)
+    jobs = [sched.submit(dict(s)) for s in WORKLOAD]
+    while sched.drain_once():
+        pass
+    bad = [(j.id, j.error) for j in jobs if j.state is not JobState.DONE]
+    cache1 = dict(run_cache_info())
+    store1 = compile_store_counters()
+    print(json.dumps({
+        "ok": not bad,
+        "failed": bad,
+        "digests": [j.result["digest"] if j.result else None for j in jobs],
+        "compiles": cache1["compiles"] - cache0["compiles"],
+        "store_hits": cache1["store_hits"] - cache0["store_hits"],
+        "store_puts": cache1["store_puts"] - cache0["store_puts"],
+        "store_corrupt": store1["corrupt"] - store0["corrupt"],
+        "store_stale": store1["stale"] - store0["stale"],
+    }, sort_keys=True))
+    return 0 if not bad else 1
+
+
+def _run_child(store_dir: str) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", store_dir],
+        capture_output=True, text=True, timeout=900, env=env, cwd=ROOT,
+    )
+    last = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    if proc.returncode != 0 or not last:
+        raise RuntimeError(
+            f"child failed rc={proc.returncode}\n"
+            f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-2000:]}"
+        )
+    return json.loads(last[-1])
+
+
+def main() -> int:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--child":
+        return child(sys.argv[2])
+    store_dir = (
+        sys.argv[1] if len(sys.argv) > 1
+        else tempfile.mkdtemp(prefix="witt_warm_start_")
+    )
+    os.makedirs(store_dir, exist_ok=True)
+    failures = []
+
+    cold = _run_child(store_dir)
+    print(f"cold : {json.dumps(cold, sort_keys=True)}")
+    if cold["compiles"] < 1 or cold["store_puts"] < 1:
+        failures.append(
+            f"cold run compiled {cold['compiles']} / published "
+            f"{cold['store_puts']} — the store is not being populated"
+        )
+
+    warm = _run_child(store_dir)
+    print(f"warm : {json.dumps(warm, sort_keys=True)}")
+    if warm["compiles"] != 0:
+        failures.append(
+            f"warm restart performed {warm['compiles']} fresh XLA "
+            "compiles (contract: ZERO — every program must come from "
+            "the store)"
+        )
+    if warm["store_hits"] < 1:
+        failures.append("warm restart never hit the compile store")
+    if warm["digests"] != cold["digests"]:
+        failures.append(
+            "warm-start results differ from the cold run — the "
+            "deserialized executables are not the same programs"
+        )
+
+    # vandalize every payload: the store must degrade, not crash
+    for name in os.listdir(store_dir):
+        if name.endswith(".bin"):
+            path = os.path.join(store_dir, name)
+            data = open(path, "rb").read()
+            with open(path, "wb") as f:
+                f.write(data[: max(1, len(data) // 3)])
+    dirty = _run_child(store_dir)
+    print(f"dirty: {json.dumps(dirty, sort_keys=True)}")
+    if not dirty["ok"]:
+        failures.append(f"corrupt store crashed the workload: {dirty}")
+    if dirty["compiles"] < 1 or dirty["store_corrupt"] < 1:
+        failures.append(
+            f"corrupt entries were not detected+recompiled "
+            f"(compiles={dirty['compiles']}, "
+            f"corrupt={dirty['store_corrupt']})"
+        )
+    if dirty["digests"] != cold["digests"]:
+        failures.append("corrupt-store fallback changed the results")
+
+    if failures:
+        print("warm_start_smoke: FAILED", file=sys.stderr)
+        for msg in failures:
+            print(f"  - {msg}", file=sys.stderr)
+        return 1
+    print(
+        f"warm_start_smoke: OK — cold {cold['compiles']} compiles / "
+        f"{cold['store_puts']} puts; warm 0 compiles / "
+        f"{warm['store_hits']} hits; dirty fallback "
+        f"{dirty['compiles']} recompiles"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,216 @@
+"""Compile-store contracts (runtime/compile_store.py, ISSUE 13).
+
+The store's one promise: a hit is bitwise the program that was put, and
+EVERYTHING else — missing entry, stale environment (jaxlib/jax version,
+ENGINE_LAYOUT, backend, device count), truncated or corrupted payload,
+garbage manifest — degrades to "compile fresh", counted but never
+raised into a dispatch path.  The invalidation matrix here is the
+warm-start safety net: a store written by an older binary must cost
+time, never correctness.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.runtime.compile_store import (
+    STORE_FORMAT,
+    CompileStore,
+    DurableJit,
+    compile_store_counters,
+    durable_jit,
+    geometry_signature,
+    get_compile_store,
+    set_compile_store,
+)
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return CompileStore(str(tmp_path / "store"))
+
+
+@pytest.fixture(autouse=True)
+def _no_process_default():
+    """Keep the module-level default store out of these tests (and
+    restore whatever the process had installed)."""
+    prev = get_compile_store()
+    set_compile_store(None)
+    yield
+    set_compile_store(prev)
+
+
+def _compiled(scale=2.0):
+    """A tiny but real compiled executable."""
+    fn = jax.jit(lambda x: x * scale + 1.0)
+    x = jnp.arange(8, dtype=jnp.float32)
+    return fn.lower(x).compile(), x
+
+
+def _delta(before, after):
+    return {k: after[k] - before[k] for k in after}
+
+
+class TestRoundTrip:
+    def test_put_get_bitwise(self, store):
+        compiled, x = _compiled()
+        want = np.asarray(compiled(x))
+        c0 = compile_store_counters()
+        assert store.put("prog/a", compiled)
+        loaded = store.get("prog/a")
+        assert loaded is not None
+        np.testing.assert_array_equal(np.asarray(loaded(x)), want)
+        d = _delta(c0, compile_store_counters())
+        assert d["stores"] == 1 and d["hits"] == 1
+        assert d["stale"] == 0 and d["corrupt"] == 0
+
+    def test_missing_entry_is_a_miss(self, store):
+        c0 = compile_store_counters()
+        assert store.get("prog/never-written") is None
+        d = _delta(c0, compile_store_counters())
+        assert d["misses"] == 1 and d["corrupt"] == 0
+
+    def test_entries_lists_manifests(self, store):
+        compiled, _ = _compiled()
+        store.put("prog/a", compiled)
+        entries = store.entries()
+        assert len(entries) == 1
+        assert entries[0]["stable_key"] == "prog/a"
+        assert entries[0]["format"] == STORE_FORMAT
+        assert store.stats()["entries"] == 1
+
+    def test_unserializable_put_counts_error(self, store):
+        c0 = compile_store_counters()
+        assert store.put("prog/bad", object()) is False
+        d = _delta(c0, compile_store_counters())
+        assert d["errors"] == 1 and d["stores"] == 0
+
+
+def _edit_manifest(store, key, **overrides):
+    man_path, _ = store._paths(key)
+    with open(man_path) as f:
+        manifest = json.load(f)
+    manifest.update(overrides)
+    with open(man_path, "w") as f:
+        json.dump(manifest, f)
+
+
+class TestInvalidation:
+    """The matrix: each corruption/staleness mode must fall back to
+    None (fresh compile) with the right counter — never crash, never
+    silently reuse."""
+
+    def _stored(self, store):
+        compiled, x = _compiled()
+        assert store.put("prog/k", compiled)
+        return x
+
+    @pytest.mark.parametrize(
+        "field,value",
+        [
+            ("jaxlib", "0.0.1-older"),
+            ("jax", "0.0.1-older"),
+            ("engine_layout", "timewheel-v0-ancient"),
+            ("backend", "tpu-v9"),
+            ("device_count", "999"),
+            ("format", "witt-compile-store/v0"),
+            ("stable_key", "prog/other"),
+        ],
+    )
+    def test_stale_environment_falls_back(self, store, field, value):
+        self._stored(store)
+        _edit_manifest(store, "prog/k", **{field: value})
+        c0 = compile_store_counters()
+        assert store.get("prog/k") is None
+        d = _delta(c0, compile_store_counters())
+        assert d["stale"] == 1 and d["hits"] == 0
+
+    def test_truncated_payload_is_corrupt(self, store):
+        self._stored(store)
+        _, bin_path = store._paths("prog/k")
+        data = open(bin_path, "rb").read()
+        with open(bin_path, "wb") as f:
+            f.write(data[: len(data) // 2])
+        c0 = compile_store_counters()
+        assert store.get("prog/k") is None
+        d = _delta(c0, compile_store_counters())
+        assert d["corrupt"] == 1 and d["hits"] == 0
+
+    def test_flipped_payload_byte_is_corrupt(self, store):
+        self._stored(store)
+        _, bin_path = store._paths("prog/k")
+        data = bytearray(open(bin_path, "rb").read())
+        data[len(data) // 2] ^= 0xFF  # same length, wrong checksum
+        with open(bin_path, "wb") as f:
+            f.write(bytes(data))
+        c0 = compile_store_counters()
+        assert store.get("prog/k") is None
+        assert _delta(c0, compile_store_counters())["corrupt"] == 1
+
+    def test_garbage_manifest_is_corrupt(self, store):
+        self._stored(store)
+        man_path, _ = store._paths("prog/k")
+        with open(man_path, "w") as f:
+            f.write("{not json at all")
+        c0 = compile_store_counters()
+        assert store.get("prog/k") is None
+        assert _delta(c0, compile_store_counters())["corrupt"] == 1
+
+    def test_missing_payload_is_corrupt(self, store):
+        self._stored(store)
+        _, bin_path = store._paths("prog/k")
+        os.remove(bin_path)
+        c0 = compile_store_counters()
+        assert store.get("prog/k") is None
+        assert _delta(c0, compile_store_counters())["corrupt"] == 1
+
+
+class TestDurableJit:
+    def test_warm_start_pays_zero_compiles(self, store):
+        x = jnp.arange(16, dtype=jnp.float32)
+        fn = lambda v: v * 3.0  # noqa: E731
+        cold = durable_jit(fn, "djit/warm", store)
+        want = np.asarray(cold(x))
+        assert cold.compiles == 1  # fresh compile, published to store
+        # "second process": a new DurableJit against the same store
+        warm = DurableJit(fn, "djit/warm", store)
+        np.testing.assert_array_equal(np.asarray(warm(x)), want)
+        assert warm.compiles == 0  # zero-compile warm start
+        # repeat calls stay in the in-memory program table
+        warm(x)
+        assert warm.compiles == 0
+
+    def test_corrupt_store_entry_recompiles_cleanly(self, store):
+        x = jnp.arange(16, dtype=jnp.float32)
+        fn = lambda v: v - 1.0  # noqa: E731
+        cold = durable_jit(fn, "djit/corrupt", store)
+        want = np.asarray(cold(x))
+        key = f"djit/corrupt/geom-{geometry_signature((x,))}"
+        _, bin_path = store._paths(key)
+        with open(bin_path, "wb") as f:
+            f.write(b"\x00garbage")
+        warm = DurableJit(fn, "djit/corrupt", store)
+        np.testing.assert_array_equal(np.asarray(warm(x)), want)
+        assert warm.compiles == 1  # clean fallback, not a crash
+
+    def test_geometry_splits_programs(self, store):
+        fn = lambda v: v + 1  # noqa: E731
+        dj = durable_jit(fn, "djit/geom", store)
+        dj(jnp.zeros(4, jnp.float32))
+        dj(jnp.zeros(8, jnp.float32))  # different shape -> new program
+        assert dj.compiles == 2
+        dj(jnp.zeros(4, jnp.float32))
+        assert dj.compiles == 2
+
+
+class TestProcessDefault:
+    def test_set_and_clear(self, tmp_path):
+        st = set_compile_store(str(tmp_path / "dflt"))
+        assert isinstance(st, CompileStore)
+        assert get_compile_store() is st
+        set_compile_store(None)
+        assert get_compile_store() is None

@@ -1,0 +1,81 @@
+"""Device-group partitioning (parallel/device_groups.py, ISSUE 13).
+
+Wave packing's hardware contract: the visible devices split into G
+contiguous, disjoint, equal groups; a batch placed on a group is
+COMMITTED there (XLA cannot migrate it mid-wave); and placement never
+changes a replica row's bytes — which is what lets the scheduler
+promise bitwise identity between single-lane and wave-packed runs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.parallel import DeviceGroup, make_device_groups
+
+
+class TestPartition:
+    def test_groups_are_contiguous_disjoint_equal(self):
+        devs = jax.devices()
+        groups = make_device_groups(2)
+        assert [g.index for g in groups] == [0, 1]
+        per = len(devs) // 2
+        assert all(len(g.devices) == per for g in groups)
+        flat = [d for g in groups for d in g.devices]
+        assert flat == devs  # contiguous cover, no overlap
+
+    def test_single_group_is_whole_machine(self):
+        (g,) = make_device_groups(1)
+        assert list(g.devices) == jax.devices()
+
+    def test_invalid_counts_rejected(self):
+        n = len(jax.devices())
+        with pytest.raises(ValueError):
+            make_device_groups(0)
+        with pytest.raises(ValueError):
+            make_device_groups(n + 1)
+        if n > 1:
+            with pytest.raises(ValueError):  # 3 does not divide 8
+                make_device_groups(3)
+
+    def test_explicit_device_list(self):
+        devs = jax.devices()[:2]
+        groups = make_device_groups(2, devices=devs)
+        assert [list(g.devices) for g in groups] == [[devs[0]], [devs[1]]]
+
+
+class TestPlacement:
+    def _stacked(self, rows):
+        return {
+            "a": jnp.arange(rows * 4, dtype=jnp.float32).reshape(rows, 4),
+            "b": jnp.arange(rows, dtype=jnp.int32),
+        }
+
+    def test_divisible_rows_shard_across_group(self):
+        group = make_device_groups(2)[1]
+        rows = len(group.devices)
+        placed = group.place(self._stacked(rows))
+        devices = placed["a"].sharding.device_set
+        assert devices == set(group.devices)  # committed to THIS group
+
+    def test_indivisible_rows_commit_to_first_device(self):
+        group = make_device_groups(2)[0]
+        rows = len(group.devices) + 1  # cannot shard evenly
+        placed = group.place(self._stacked(rows))
+        assert placed["a"].sharding.device_set == {group.devices[0]}
+
+    def test_placement_preserves_bytes(self):
+        state = self._stacked(4)
+        for group in make_device_groups(2):
+            placed = group.place(state)
+            for k in state:
+                np.testing.assert_array_equal(
+                    np.asarray(placed[k]), np.asarray(state[k])
+                )
+
+    def test_group_mesh_and_label(self):
+        g = make_device_groups(2)[0]
+        assert isinstance(g, DeviceGroup)
+        assert g.mesh.devices.shape == (len(g.devices),)
+        assert g.label().startswith("group0[")

@@ -293,3 +293,146 @@ class TestPreemption:
         assert sched.metrics.resumes_total >= 1
         assert low.result["digest"] == sched.run_singleton(low_spec)["digest"]
         assert hi.result["digest"] == sched.run_singleton(hi_spec)["digest"]
+
+
+class TestHorizonSharding:
+    """ISSUE 13: mixed-sim_ms specs split into fixed chunk units at
+    admission, pack into ONE family, finish at their own boundaries
+    (remainders ride a 1-row run), all bitwise-equal to singletons."""
+
+    def test_mixed_horizons_share_family_and_match_singletons(self):
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=4, horizon_quantum_ms=50,
+        )
+        specs = [
+            {**BASE, "seed": 1, "simMs": 100},
+            {**BASE, "seed": 2, "simMs": 200},
+            {**BASE, "seed": 3, "simMs": 150},
+            {**BASE, "seed": 4, "simMs": 130},  # 2 units + 30ms remainder
+        ]
+        cache0 = dict(run_cache_info())
+        jobs = [sched.submit(s) for s in specs]
+        assert len({j.compat for j in jobs}) == 1, (
+            "mixed horizons fragmented into multiple families"
+        )
+        while sched.drain_once():
+            pass
+        for j, s in zip(jobs, specs):
+            assert j.state is JobState.DONE, (s, j.error)
+            assert j.result["time"] == s["simMs"]
+            assert j.result["digest"] == sched.run_singleton(s)["digest"], s
+        # <=2 programs: the shared unit-chunk program + one 1-row
+        # remainder program for the 30ms tail
+        cache1 = dict(run_cache_info())
+        assert cache1["compiles"] - cache0["compiles"] <= 2
+
+    def test_quantum_zero_keeps_direct_mode(self):
+        sched = BatchScheduler(auto_start=False)
+        a = sched.submit({**BASE, "seed": 0, "simMs": 100})
+        b = sched.submit({**BASE, "seed": 0, "simMs": 200})
+        assert a.compat != b.compat  # no quantum: horizons still split
+
+    def test_quantum_merges_only_divisible_units(self):
+        sched = BatchScheduler(auto_start=False, horizon_quantum_ms=60)
+        a = sched.submit({**BASE, "seed": 0, "simMs": 120})
+        b = sched.submit({**BASE, "seed": 1, "simMs": 180})
+        c = sched.submit({**BASE, "seed": 2, "simMs": 60})
+        assert a.compat == b.compat == c.compat
+
+
+class TestWavePacking:
+    """ISSUE 13: G dispatch lanes over G device groups — families run
+    concurrently, stickily bound to one lane, bitwise identical to the
+    single-lane schedule."""
+
+    FLOOD = {
+        "protocol": "P2PFlood",
+        "params": {"node_count": 32, "msg_count": 2, "msg_to_receive": 2,
+                   "peers_count": 3},
+        "simMs": 60,
+    }
+
+    def _workload(self):
+        out = []
+        for seed in range(3):
+            out.append({**BASE, "seed": seed})
+            out.append({**self.FLOOD, "seed": seed})
+        return out
+
+    def test_two_lanes_bitwise_identical_to_single(self):
+        specs = self._workload()
+        ref = BatchScheduler(auto_start=False, max_batch_replicas=4)
+        ref_jobs = [ref.submit(s) for s in specs]
+        while ref.drain_once():
+            pass
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=4, device_groups=2,
+        )
+        jobs = [sched.submit(s) for s in specs]
+        sched.start()
+        for j in jobs:
+            assert j.done_event.wait(300), "wave job timed out"
+        sched.stop()
+        for j, r, s in zip(jobs, ref_jobs, specs):
+            assert j.state is JobState.DONE, (s, j.error)
+            assert r.state is JobState.DONE, (s, r.error)
+            assert j.result["digest"] == r.result["digest"], s
+        # two families -> two lanes, stickily bound
+        lanes = set(sched._family_lane.values())
+        assert len(sched._family_lane) == 2
+        assert sched.metrics.wave_width_max >= 1
+        assert sched.status()["deviceGroups"] == 2
+        assert len(lanes) <= 2
+
+    def test_drain_once_defaults_to_lane_zero(self):
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=4, device_groups=2,
+        )
+        job = sched.submit({**BASE, "seed": 7})
+        assert sched.drain_once()  # no lane argument: legacy entry
+        assert job.state is JobState.DONE, job.error
+        assert sched._family_lane[job.compat] == 0
+
+    def test_family_sticky_to_bound_lane(self):
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=4, device_groups=2,
+        )
+        a = sched.submit({**BASE, "seed": 0})
+        assert sched.drain_once(1)
+        assert a.state is JobState.DONE, a.error
+        b = sched.submit({**BASE, "seed": 1})
+        # lane 0 may not claim a family bound to lane 1
+        assert not sched.drain_once(0)
+        assert b.state is JobState.QUEUED
+        assert sched.drain_once(1)
+        assert b.state is JobState.DONE, b.error
+
+
+class TestRetryAfterPacing:
+    """ISSUE 13 satellite: Retry-After paced per family — a slow family
+    must not inflate a fast family's backoff hint."""
+
+    def test_family_ema_separates_hints(self):
+        sched = BatchScheduler(auto_start=False, max_batch_replicas=4)
+        sched._note_batch_time("fam-slow", 100.0)
+        sched._note_batch_time("fam-fast", 2.0)
+        slow = sched.retry_after_s("fam-slow")
+        fast = sched.retry_after_s("fam-fast")
+        assert slow > fast
+        # unknown family falls back to the global EMA (bounded, >= 1)
+        assert sched.retry_after_s("fam-unknown") >= 1
+        assert sched.retry_after_s() >= 1
+
+    def test_depth_counts_only_that_family(self):
+        sched = BatchScheduler(auto_start=False, max_batch_replicas=1)
+        a = sched.submit({**BASE, "seed": 0})
+        for seed in range(3):
+            sched.submit(
+                {"protocol": "PingPong", "params": {"node_ct": 48},
+                 "simMs": 60, "seed": seed}
+            )
+        assert sched.queue.depth_for(a.compat) == 1
+        assert sched.queue.depth() == 4
+        sched._note_batch_time(a.compat, 4.0)
+        # 1 pending / capacity 1 -> 1 batch ahead at ~4s/batch
+        assert sched.retry_after_s(a.compat) <= sched.retry_after_s()

@@ -382,3 +382,60 @@ class TestDurableRunEndpoints:
         assert status == 200 and out2["ok"] is True
         assert out2["interrupted"] is False
         assert out2["time"] == 200  # state was consistent at the boundary
+
+
+class TestRunMsGateway:
+    """ISSUE 13 satellite: runMs is a submitted job over the serve/
+    queue — one dispatch discipline for the whole fleet — with the
+    legacy busy/degraded/queue-full 503 semantics preserved."""
+
+    def _fresh(self, node_ct=30, **sched_kw):
+        from wittgenstein_tpu.serve import BatchScheduler
+
+        ws = WServer(scheduler=BatchScheduler(**sched_kw)) if sched_kw \
+            else WServer()
+        params = json.loads(
+            ws.server.get_protocol_parameters("PingPong").to_json()
+        )
+        params["node_ct"] = node_ct
+        ws.dispatch("POST", "/w/network/init/PingPong", json.dumps(params))
+        return ws
+
+    def test_runms_routed_through_job_queue(self):
+        ws = self._fresh()
+        submitted0 = ws.jobs.metrics.jobs_submitted
+        completed0 = ws.jobs.metrics.jobs_completed
+        status, out = ws.dispatch("POST", "/w/network/runMs/120", "")
+        assert status == 200
+        assert out["ok"] is True and out["ranMs"] == 120
+        assert "occupancy" in out and "dropped" in out
+        assert ws.jobs.metrics.jobs_submitted == submitted0 + 1
+        assert ws.jobs.metrics.jobs_completed == completed0 + 1
+
+    def test_runms_queue_full_503_with_retry_after(self):
+        from wittgenstein_tpu.serve import BatchScheduler, JobQueue
+
+        ws = WServer(scheduler=BatchScheduler(
+            queue=JobQueue(max_depth=1), auto_start=False,
+        ))
+        # fill the queue; no worker drains it (auto_start=False)
+        ws.jobs.queue.submit(
+            __import__("wittgenstein_tpu.serve.jobs", fromlist=["Job"]).Job(
+                spec=None, compat="filler", kind="legacy",
+                thunk=lambda: None,
+            ),
+            retry_after_s=1,
+        )
+        status, resp = ws.dispatch("POST", "/w/network/runMs/50", "")
+        assert status == 503
+        assert resp.payload["busy"] is True
+        assert int(resp.headers["Retry-After"]) >= 1
+        assert not ws.run_lock.locked()  # released on the rejection path
+
+    def test_runms_errors_keep_status_mapping(self):
+        # uninitialized -> 409 even through the queue (RuntimeError is
+        # re-raised from the job record into the handler)
+        ws = WServer()
+        status, _ = ws.dispatch("POST", "/w/network/runMs/10", "")
+        assert status == 409
+        assert ws.degraded is False

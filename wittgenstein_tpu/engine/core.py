@@ -395,6 +395,34 @@ class BatchedNetwork:
             bitops_backend(),
         )
 
+    def stable_cache_key(self) -> tuple:
+        """cache_key minus the process-lifetime id() components: the
+        cross-process identity the durable compile store keys on.  Two
+        engines with equal stable keys trace the same program *provided*
+        their behavior params round-trip through repr/str — true for the
+        dataclass params and named latency models this codebase builds;
+        an exotic latency whose str() hides state must not be served
+        from the store (give it a distinguishing __str__)."""
+        return (
+            type(self.protocol).__name__,
+            repr(getattr(self.protocol, "params", None)),
+            str(self.latency),
+            self.n_nodes,
+            self.capacity,
+            self.wheel_rows,
+            self.wheel_slots,
+            self.overflow_capacity,
+            int(self.msg_discard_time),
+            type(self.throughput).__name__ if self.throughput else None,
+            getattr(self, "node_axis", None),
+            self.telemetry.key() if self.telemetry is not None else None,
+            self.faults.key() if self.faults is not None else None,
+            self.annotate,
+            self.fuse_step,
+            self.lanes.key(),
+            bitops_backend(),
+        )
+
     def _scope(self, name: str):
         """jax.named_scope for engine phase `name` (ENGINE_PHASE_SCOPES)
         when annotation is on; a no-op context otherwise."""

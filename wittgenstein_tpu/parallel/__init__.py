@@ -19,6 +19,7 @@ Both run identically on a virtual CPU mesh
 multi-host (DCN) — the mesh is the only thing that changes.
 """
 
+from .device_groups import DeviceGroup, make_device_groups
 from .node_shard import (
     enable_node_sharding,
     node_shard_bytes,
@@ -33,6 +34,8 @@ from .replica_shard import (
 )
 
 __all__ = [
+    "DeviceGroup",
+    "make_device_groups",
     "clear_run_cache",
     "enable_node_sharding",
     "node_shard_bytes",

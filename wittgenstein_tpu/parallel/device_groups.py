@@ -1,0 +1,99 @@
+"""Device groups: partition the visible mesh into G independent lanes.
+
+The replica axis shards ONE batch across ALL devices
+(parallel.replica_shard); that is the right shape when a single
+compatibility family owns the machine.  A serving fleet has K families
+in flight — with one global mesh they *serialize* through one worker
+even though each batch only needs 1/G of the devices.  A DeviceGroup is
+the unit of that partition: a contiguous slice of ``jax.devices()``
+wrapped in its own one-axis ``Mesh``, so each scheduler lane places its
+batches onto its own devices and up to G families execute concurrently
+("wave packing").
+
+Placement discipline: ``place`` shards the stacked state across the
+group's devices when the replica count divides the group size, else it
+commits the whole batch to the group's first device — either way the
+arrays are COMMITTED to this group, so XLA never migrates a lane's work
+onto another lane's devices mid-wave.  Row bytes are placement-
+independent (replica rows are elementwise lane-independent under vmap),
+which is why wave packing can promise bitwise identity with the
+single-worker schedule.
+
+Validated on CPU via --xla_force_host_platform_device_count, same as
+every other mesh path in parallel/.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceGroup:
+    """One lane's slice of the machine: index + devices + its own
+    replica-axis mesh."""
+
+    index: int
+    devices: tuple
+
+    @property
+    def mesh(self) -> Mesh:
+        import numpy as np
+
+        return Mesh(np.array(self.devices), ("replicas",))
+
+    def sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P("replicas"))
+
+    def place(self, states):
+        """Commit a stacked state pytree (leading replica axis) to this
+        group: replica-sharded when the leading axis divides the group
+        size, whole-batch on the first device otherwise (correct either
+        way; the sharded form is the throughput case)."""
+        leaves = jax.tree_util.tree_leaves(states)
+        n_rows = leaves[0].shape[0] if leaves and leaves[0].shape else 0
+        if n_rows and n_rows % len(self.devices) == 0:
+            sharding = self.sharding()
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sharding), states
+            )
+        dev = self.devices[0]
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, dev), states
+        )
+
+    def label(self) -> str:
+        return f"group{self.index}[{len(self.devices)}dev]"
+
+
+def make_device_groups(
+    n_groups: int, devices: Optional[Sequence] = None
+) -> List[DeviceGroup]:
+    """Partition ``devices`` (default: all visible) into ``n_groups``
+    contiguous equal slices.  Group count must divide the device count —
+    an uneven fleet would give lanes different compiled-program
+    geometries and silently break the one-compile-per-family contract."""
+    if n_groups < 1:
+        raise ValueError(f"n_groups must be >= 1, got {n_groups}")
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if not devs:
+        raise ValueError("no devices visible")
+    if n_groups > len(devs):
+        raise ValueError(
+            f"n_groups={n_groups} exceeds visible devices ({len(devs)})"
+        )
+    if len(devs) % n_groups != 0:
+        raise ValueError(
+            f"n_groups={n_groups} must divide the device count "
+            f"({len(devs)}) — uneven groups would compile per-lane "
+            "program geometries"
+        )
+    per = len(devs) // n_groups
+    return [
+        DeviceGroup(g, tuple(devs[g * per : (g + 1) * per]))
+        for g in range(n_groups)
+    ]

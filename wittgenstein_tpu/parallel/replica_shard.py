@@ -16,10 +16,20 @@ config, horizon, input geometry): FLOPs, bytes accessed, live/temp HBM,
 and compile seconds — run_cache_metrics() exports all of it, and the
 hit/miss/eviction/compile-seconds counters feed the server's
 witt_run_cache_* Prometheus families.
+
+Warm starts (ISSUE-13): when a durable compile store is installed
+(runtime.compile_store — set_compile_store / $WITT_COMPILE_STORE), the
+per-geometry compile first consults the store under the engine's
+*stable* cache key (net.stable_cache_key(), id()-free) and publishes
+fresh compiles back to it.  A store hit bypasses lower().compile()
+entirely, so the monotonic "compiles" counter genuinely stays 0 on a
+warm restart — the counter-asserted zero-compile contract; store hits
+tick "store_hits" instead.
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
 from collections import OrderedDict
@@ -60,6 +70,10 @@ _COUNTERS = {
     "evictions": 0,
     "compiles": 0,
     "compile_seconds_total": 0.0,
+    # durable compile-store integration: programs adopted from /
+    # published to the cross-process store (runtime.compile_store)
+    "store_hits": 0,
+    "store_puts": 0,
 }
 
 
@@ -74,6 +88,17 @@ class _CachedRun:
         self.key = key
         self.protocol = type(net.protocol).__name__
         self.sim_ms = int(sim_ms)
+        # restart-stable identity for the durable compile store; engines
+        # predating stable_cache_key simply never use the store
+        stable = getattr(net, "stable_cache_key", None)
+        self.stable_key = (
+            "run/"
+            + hashlib.blake2b(
+                repr((stable(), self.sim_ms)).encode(), digest_size=12
+            ).hexdigest()
+            if callable(stable)
+            else None
+        )
 
         @jax.jit
         def fn(s):
@@ -117,6 +142,13 @@ class _CachedRun:
             )
         return tuple(sig)
 
+    def _store_key(self, states) -> "str | None":
+        if self.stable_key is None:
+            return None
+        from ..runtime.compile_store import geometry_signature
+
+        return f"{self.stable_key}/geom-{geometry_signature(states)}"
+
     def __call__(self, states):
         sig = self._signature(states)
         compiled = self._programs.get(sig)
@@ -124,18 +156,43 @@ class _CachedRun:
             with self._compile_lock:
                 compiled = self._programs.get(sig)
                 if compiled is None:
-                    t0 = time.perf_counter()
-                    compiled = self._jit.lower(states).compile()
-                    dt = time.perf_counter() - t0
-                    _COUNTERS["compiles"] += 1
-                    _COUNTERS["compile_seconds_total"] += dt
+                    from ..runtime.compile_store import get_compile_store
+
+                    store = get_compile_store()
+                    skey = (
+                        self._store_key(states)
+                        if store is not None
+                        else None
+                    )
+                    if skey is not None:
+                        compiled = store.get(skey)
+                    if compiled is not None:
+                        # adopted from the durable store: no lowering
+                        # happened, so "compiles" must NOT tick (the
+                        # zero-compile warm-start contract) and there is
+                        # no fresh cost analysis to book
+                        _COUNTERS["store_hits"] += 1
+                        self._summaries[sig] = {
+                            "replicas": next(
+                                (s[0][0] for s in sig if s[0]), None
+                            ),
+                            "loaded_from_store": True,
+                        }
+                    else:
+                        t0 = time.perf_counter()
+                        compiled = self._jit.lower(states).compile()
+                        dt = time.perf_counter() - t0
+                        _COUNTERS["compiles"] += 1
+                        _COUNTERS["compile_seconds_total"] += dt
+                        self._summaries[sig] = {
+                            "replicas": next(
+                                (s[0][0] for s in sig if s[0]), None
+                            ),
+                            **compiled_cost_summary(compiled, dt),
+                        }
+                        if skey is not None and store.put(skey, compiled):
+                            _COUNTERS["store_puts"] += 1
                     self._programs[sig] = compiled
-                    self._summaries[sig] = {
-                        "replicas": next(
-                            (s[0][0] for s in sig if s[0]), None
-                        ),
-                        **compiled_cost_summary(compiled, dt),
-                    }
         return compiled(states)
 
     def summaries(self) -> list:

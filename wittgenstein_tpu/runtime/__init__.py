@@ -16,6 +16,14 @@ from .errors import (
     WatchdogTimeoutError,
     classify,
 )
+from .compile_store import (
+    CompileStore,
+    DurableJit,
+    compile_store_counters,
+    durable_jit,
+    get_compile_store,
+    set_compile_store,
+)
 from .policy import DegradePolicy, RetryPolicy, WatchdogPolicy, WatchdogWorker
 from .supervisor import (
     RunReport,
@@ -26,6 +34,12 @@ from .supervisor import (
 )
 
 __all__ = [
+    "CompileStore",
+    "DurableJit",
+    "compile_store_counters",
+    "durable_jit",
+    "get_compile_store",
+    "set_compile_store",
     "DegradePolicy",
     "DeviceLostError",
     "DurableRunError",

@@ -183,6 +183,7 @@ class Supervisor:
         tracer: Any = None,
         ctx: Optional[TraceContext] = None,
         recorder: Optional[FlightRecorder] = None,
+        placement: Optional[Callable[[Any], Any]] = None,
     ):
         if n_chunks < 1:
             raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
@@ -219,6 +220,11 @@ class Supervisor:
         # stored run_id so kill+resume stays one run)
         self.ctx = ctx
         self.recorder = get_recorder() if recorder is None else recorder
+        # optional device placement for resumed/anchored host states
+        # (a serve lane's device group): applied instead of the default
+        # jnp.asarray materialization, never in degraded mode (CPU
+        # fallback overrides any group placement)
+        self.placement = placement
         self._wd_worker: Optional[WatchdogWorker] = None
         self._first_call_done = False
         self._degraded = False
@@ -243,6 +249,8 @@ class Supervisor:
             return jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, cpu), host_state
             )
+        if self.placement is not None:
+            return self.placement(host_state)
         return jax.tree_util.tree_map(jnp.asarray, host_state)
 
     # -- chunk execution ------------------------------------------------
@@ -619,6 +627,33 @@ class Supervisor:
         # degraded path reuses it (jit specializes on input placement)
         if run_key is None:
             run_key = stable_run_key(net, state, n_chunks, chunk_ms)
+        # durable compiles: with a compile store installed the chunk fn
+        # dispatches through store-backed AOT programs keyed on the
+        # engine's stable identity — a restarted process resumes a
+        # checkpointed run without re-paying the chunk compile.  Donated
+        # buffers keep the plain jit path: serialized executables do not
+        # carry donation, and donation is opt-in anyway (the jaxlib
+        # 0.4.37 landmine below).  Geometry (incl. placement) is part of
+        # the store key, so the degraded CPU re-placement still works.
+        if not donate:
+            from .compile_store import durable_jit, get_compile_store
+
+            if get_compile_store() is not None:
+                stable = getattr(net, "stable_cache_key", None)
+                base = (
+                    repr(stable()) if callable(stable) else run_key
+                )
+                import hashlib as _hashlib
+
+                chunk_fn = durable_jit(
+                    chunk_fn,
+                    "chunk/"
+                    + _hashlib.blake2b(
+                        f"{base}|{chunk_ms}|{int(stop_when_done)}|"
+                        f"{int(batched)}".encode(),
+                        digest_size=12,
+                    ).hexdigest(),
+                )
         return cls(
             chunk_fn,
             state,

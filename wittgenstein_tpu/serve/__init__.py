@@ -13,6 +13,7 @@ from .jobs import (
     QueueFullError,
     SERVE_PROTOCOLS,
     UnknownJobError,
+    chunk_schedule,
     plan_from_spec,
     serve_protocol,
 )
@@ -30,6 +31,7 @@ __all__ = [
     "ServeMetrics",
     "SERVE_PROTOCOLS",
     "UnknownJobError",
+    "chunk_schedule",
     "plan_from_spec",
     "quantile",
     "serve_protocol",

@@ -152,6 +152,34 @@ def serve_protocol(name: str) -> ServeProtocol:
 
 
 # ---------------------------------------------------------------------------
+# horizon sharding
+
+
+def chunk_schedule(
+    sim_ms: int, chunk_ms: int = 0, quantum_ms: int = 0
+) -> List[int]:
+    """The exact sequence of run_ms steps a job executes — ONE function
+    so the batched path and the singleton reference replay the same
+    boundaries (the telemetry loop census is chunk-schedule-dependent,
+    so bit-identity requires agreeing on this list).
+
+    Explicit ``chunk_ms`` wins (admission validated divisibility).
+    Otherwise a scheduler-level ``quantum_ms`` splits any longer horizon
+    into fixed quantum units plus one remainder step, so mixed-simMs
+    tenants share one chunked family instead of fragmenting into
+    per-horizon compiled programs.  Horizons >= the quantum are
+    quantized (sim_ms == quantum is ONE quantum unit — it rides the
+    shared chunked family, not a private direct one); shorter horizons
+    stay direct (one step)."""
+    if chunk_ms:
+        return [chunk_ms] * (sim_ms // chunk_ms)
+    if quantum_ms and sim_ms >= quantum_ms:
+        full, rem = divmod(sim_ms, quantum_ms)
+        return [quantum_ms] * full + ([rem] if rem else [])
+    return [sim_ms]
+
+
+# ---------------------------------------------------------------------------
 # fault-plan parsing
 
 
@@ -375,6 +403,13 @@ class JobQueue:
     def depth(self) -> int:
         with self._lock:
             return len(self._pending)
+
+    def depth_for(self, compat: str) -> int:
+        """Pending jobs of one compatibility family — the per-family
+        Retry-After pacing reads this instead of the global depth, so a
+        slow family's backlog doesn't inflate a fast family's hint."""
+        with self._lock:
+            return sum(1 for j in self._pending if j.compat == compat)
 
     def jobs(self) -> List[Job]:
         with self._lock:

@@ -64,6 +64,11 @@ class ServeMetrics:
         self.preemptions_total = 0
         self.resumes_total = 0
         self.batch_seconds_total = 0.0
+        # wave packing: width = lanes busy when a dispatch starts
+        self.wave_width_last = 0
+        self.wave_width_max = 0
+        # lane index -> dispatch count (busy seconds live on the lanes)
+        self._lane_dispatches: dict = {}
         self._latency_s = deque(maxlen=self.WINDOW)
         self._ttfr_s = deque(maxlen=self.WINDOW)
         # (run_id, tenant, latency_s) of recently completed jobs
@@ -146,6 +151,17 @@ class ServeMetrics:
                 job.first_result_at = time.monotonic()
                 self._ttfr_s.append(job.first_result_at - job.submitted_at)
 
+    def observe_wave(self, lane: int, width: int) -> None:
+        """One dispatch started on ``lane`` while ``width`` lanes were
+        busy (this one included) — the wave-packing headline: a steady
+        width of G means G families genuinely execute concurrently."""
+        with self._lock:
+            self.wave_width_last = width
+            self.wave_width_max = max(self.wave_width_max, width)
+            self._lane_dispatches[lane] = (
+                self._lane_dispatches.get(lane, 0) + 1
+            )
+
     def observe_preemption(self) -> None:
         with self._lock:
             self.preemptions_total += 1
@@ -188,6 +204,9 @@ class ServeMetrics:
                 "preemptions_total": self.preemptions_total,
                 "resumes_total": self.resumes_total,
                 "batch_seconds_total": round(self.batch_seconds_total, 4),
+                "wave_width_last": self.wave_width_last,
+                "wave_width_max": self.wave_width_max,
+                "lane_dispatches": dict(self._lane_dispatches),
             }
         if queue_depth is not None:
             out["queue_depth"] = queue_depth
@@ -234,6 +253,14 @@ class ServeMetrics:
             p.add("serve_batch_seconds_total",
                   round(self.batch_seconds_total, 4),
                   "wall seconds spent in batch dispatches", "counter")
+            p.add("serve_wave_width", self.wave_width_last,
+                  "busy dispatch lanes when the last batch started")
+            p.add("serve_wave_width_max", self.wave_width_max,
+                  "peak concurrent dispatch lanes observed")
+            for lane, n in sorted(self._lane_dispatches.items()):
+                p.add("serve_lane_dispatches_total", n,
+                      "dispatches issued per lane", "counter",
+                      {"lane": str(lane)})
             lat = list(self._latency_s)
             ttfr = list(self._ttfr_s)
             recent = list(self._recent_runs)

@@ -1,13 +1,20 @@
-"""The batching scheduler: compatible jobs share one compiled program.
+"""The batching scheduler: compatible jobs share one compiled program,
+compatibility families share the machine through wave packing.
 
 Grouping discipline
 -------------------
 Two jobs may ride the same ``run_ms_batched`` dispatch iff they resolve
 to the same **scenario family**: protocol name + every traced param
-(anything not in the serve registry's ``state_only`` set) + simulation
-horizon + execution mode (direct vs chunk schedule).  That pre-key is
-computed at admission from the spec alone; when the family is first
-built, the full static digest is extended with
+(anything not in the serve registry's ``state_only`` set) + execution
+geometry.  For a direct (single device call) job the geometry is the
+simulation horizon; for a chunked job it is the CHUNK UNIT only — the
+horizon itself is per-job data ("horizon sharding"): a job's total
+sim_ms is split at admission into fixed-length units
+(``jobs.chunk_schedule``), so tenants with different horizons pack into
+the same replica-axis batches and finish at their own chunk boundaries
+instead of fragmenting into per-horizon compiled programs.  That
+pre-key is computed at admission from the spec alone; when the family
+is first built, the full static digest is extended with
 ``runtime.supervisor.stable_run_key`` over the engine + template leaf
 signature — the same digest discipline the durable executor stamps into
 checkpoints — so "compatible" is defined by what actually shapes the
@@ -20,26 +27,47 @@ Every dispatch is padded to a fixed replica capacity
 (``max_batch_replicas``; padding rows are template copies whose results
 are discarded), so every batch of a family presents the identical input
 leaf signature to the run cache (parallel.replica_shard): ONE compile
-per (family, horizon) however the workload arrives.  The run cache's
+per (family, unit) however the workload arrives.  The run cache's
 monotonic hit/miss/compile counters make the claim measurable — the
-loadgen asserts it.
+loadgen asserts it.  (A quantum remainder — sim_ms not divisible by the
+unit — costs one extra 1-row program per distinct remainder length;
+divisible horizons stay inside the fixed-compile envelope.)
 
 Families hold ONE engine object each on purpose: ``net.cache_key()``
 includes ``id(protocol)``/``id(latency)``, so rebuilding the engine per
 job would defeat the cache even with identical params (simlint SL801
 pins this contract).
 
+Wave packing (dispatch lanes)
+-----------------------------
+The scheduler runs G dispatch lanes (``device_groups``), each bound to
+its own slice of the visible devices (parallel.device_groups): up to G
+compatibility families execute CONCURRENTLY, one per lane, instead of
+serializing through one worker.  A family is STICKY to the lane that
+first dispatches it — lane placement is part of the compiled program's
+input sharding, so stickiness is what keeps the one-compile-per-family
+contract under wave packing.  Claiming (queue pops, parked-batch
+resumes, family→lane binding) is serialized under one dispatch lock;
+device execution happens outside it.  With the default
+``device_groups=1`` there is exactly one lane with NO explicit
+placement — bit-for-bit the legacy single-worker scheduler.  Results
+are bitwise identical across lane layouts either way: replica rows are
+elementwise lane-independent under vmap, so placement can change only
+where a row computes, never its bytes.
+
 Preemption
 ----------
-A job with ``chunkMs`` set runs through ``runtime.Supervisor`` in
-slices of ``slice_chunks`` device calls, checkpointing every chunk via
-``engine/checkpoint.CheckpointManager``.  Between slices the worker
-checks the queue: queued work with strictly higher priority parks the
-batch (its checkpoint is the park ticket) and runs first; the parked
-batch later resumes from the checkpoint, bit-identical to an
-uninterrupted run (the supervisor's replay contract).  The chunk
-function is routed through the SAME run cache, so the chunked mode
-costs one extra compile per family, not one per slice.
+A chunked batch runs through ``runtime.Supervisor`` in slices of
+``slice_chunks`` device calls, checkpointing every chunk via
+``engine/checkpoint.CheckpointManager``.  Between slices its lane
+checks the queue: claimable work with strictly higher priority parks
+the batch (its checkpoint is the park ticket) and runs first; the
+parked batch later resumes from the checkpoint, bit-identical to an
+uninterrupted run (the supervisor's replay contract).  Slices also stop
+exactly at every member job's horizon boundary, where the finished rows
+are captured and finalized while the rest of the batch keeps running.
+The chunk function is routed through the SAME run cache, so the chunked
+mode costs one extra compile per family, not one per slice.
 """
 
 from __future__ import annotations
@@ -66,6 +94,7 @@ from .jobs import (
     JobSpec,
     JobState,
     QueueFullError,
+    chunk_schedule,
     serve_protocol,
 )
 from .metrics import ServeMetrics
@@ -117,27 +146,64 @@ def state_digest(state) -> str:
 
 class ScenarioFamily:
     """One compatibility class: a single engine object + per-params
-    single-replica templates, all sharing one traced program."""
+    single-replica templates, all sharing one traced program.
+
+    ``mode`` is "direct" (one device call per batch, horizon traced
+    into the program) or "chunked" (``unit_ms`` steps through the
+    Supervisor; horizons are per-job data)."""
 
     def __init__(self, key, digest, net, entry, tele_cfg, sim_ms, chunk_ms,
-                 base_params_key, base_template):
+                 base_params_key, base_template, mode="direct",
+                 unit_ms=None):
         self.key = key  # admission-time pre-key
         self.digest = digest  # full static digest (stable_run_key suffix)
         self.net = net
         self.entry = entry
         self.tele_cfg = tele_cfg
-        self.sim_ms = sim_ms
+        self.sim_ms = sim_ms  # first-seen horizon (informational only)
         self.chunk_ms = chunk_ms
+        self.mode = mode
+        self.unit_ms = unit_ms if unit_ms is not None else (chunk_ms or sim_ms)
         self.templates: Dict[str, object] = {base_params_key: base_template}
         self.signature = _leaf_signature(base_template)
 
 
+class _Lane:
+    """One dispatch lane: a worker thread bound to a device group (or,
+    for the single-lane scheduler, to no explicit placement at all)."""
+
+    def __init__(self, index: int, group=None):
+        self.index = index
+        self.group = group  # parallel.device_groups.DeviceGroup | None
+        self.thread: Optional[threading.Thread] = None
+        self.busy = False
+        self.dispatches = 0
+        self.busy_seconds = 0.0
+
+    def describe(self) -> dict:
+        return {
+            "index": self.index,
+            "devices": (
+                [str(d) for d in self.group.devices]
+                if self.group is not None
+                else None
+            ),
+            "busy": self.busy,
+            "dispatches": self.dispatches,
+            "busySeconds": round(self.busy_seconds, 4),
+        }
+
+
 class _ParkedBatch:
     """A chunked batch between slices: the Supervisor (whose checkpoint
-    directory is the resume ticket) plus the jobs riding it."""
+    directory is the resume ticket) plus the jobs riding it.  With
+    horizon sharding the member jobs may have different chunk counts
+    (``job_chunks``); each job's row is captured and finalized at its
+    own boundary while the batch runs on to the longest horizon."""
 
     def __init__(self, batch_id, family, jobs, supervisor, ckpt_dir,
-                 priority, capacity):
+                 priority, capacity, lane=0, job_chunks=None,
+                 job_rems=None):
         self.batch_id = batch_id
         self.family = family
         self.jobs = jobs
@@ -145,17 +211,25 @@ class _ParkedBatch:
         self.ckpt_dir = ckpt_dir
         self.priority = priority
         self.capacity = capacity
+        self.lane = lane
+        self.job_chunks = job_chunks or []
+        self.job_rems = job_rems or [0] * len(jobs)
+        self.chunks_done = 0
+        self.finished: set = set()  # job ids finalized at a boundary
         self.preempted = False
+        self.running = False  # claimed by a lane this instant
         self.started = time.monotonic()
 
 
 class BatchScheduler:
     """Queue consumer: groups, packs, dispatches, streams progress.
 
-    One worker thread serializes all device work (the engine is
-    replica-parallel, not request-parallel); HTTP handlers only touch
-    the queue and job records.  ``auto_start=False`` leaves the worker
-    off so tests can drive ``drain_once()`` deterministically."""
+    ``device_groups`` lanes each run one worker thread (wave packing);
+    the default of 1 is the legacy single-worker scheduler (the engine
+    is replica-parallel, not request-parallel, within a lane).  HTTP
+    handlers only touch the queue and job records.  ``auto_start=False``
+    leaves the workers off so tests can drive ``drain_once()``
+    deterministically (lane 0 unless told otherwise)."""
 
     def __init__(
         self,
@@ -168,16 +242,23 @@ class BatchScheduler:
         checkpoint_root: Optional[str] = None,
         auto_start: bool = True,
         recorder: Optional[FlightRecorder] = None,
+        device_groups: int = 1,
+        horizon_quantum_ms: int = 0,
     ):
         if max_batch_replicas < 1:
             raise ValueError(
                 f"max_batch_replicas must be >= 1, got {max_batch_replicas}"
+            )
+        if horizon_quantum_ms < 0:
+            raise ValueError(
+                f"horizon_quantum_ms must be >= 0, got {horizon_quantum_ms}"
             )
         self.queue = queue or JobQueue()
         self.metrics = metrics or ServeMetrics()
         self.max_batch_replicas = max_batch_replicas
         self.slice_chunks = max(1, slice_chunks)
         self.telemetry_snapshots = telemetry_snapshots
+        self.horizon_quantum_ms = horizon_quantum_ms
         self.checkpoint_root = checkpoint_root or os.path.join(
             tempfile.gettempdir(), f"witt_serve_ckpt_{os.getpid()}"
         )
@@ -190,17 +271,57 @@ class BatchScheduler:
         self._fam_lock = threading.Lock()
         self._parked: List[_ParkedBatch] = []
         self._batch_seq = 0
+        # Retry-After pacing: per-family EMA of batch wall time (a slow
+        # Handel family must not inflate a fast p2pflood tenant's
+        # backoff hint) with the global EMA as the cold-family fallback
         self._ema_batch_s = 1.0
-        self._worker: Optional[threading.Thread] = None
+        self._ema_family: Dict[str, float] = {}
+        # wave packing: lane list + the dispatch lock that serializes
+        # every claim decision (queue pops, parked resumes, family→lane
+        # binding); device execution runs outside it
+        if device_groups < 1:
+            raise ValueError(
+                f"device_groups must be >= 1, got {device_groups}"
+            )
+        if device_groups == 1:
+            # no explicit placement: bit-for-bit the legacy scheduler
+            # (and no re-placement cost for the common case)
+            self._lanes = [_Lane(0, None)]
+        else:
+            from ..parallel.device_groups import make_device_groups
+
+            self._lanes = [
+                _Lane(g.index, g) for g in make_device_groups(device_groups)
+            ]
+        self.device_groups = len(self._lanes)
+        self._dispatch_lock = threading.Lock()
+        self._family_lane: Dict[str, int] = {}
+        self._active_dispatches = 0
         self._worker_lock = threading.Lock()
         self._stop = threading.Event()
 
     # -- admission -----------------------------------------------------
 
+    def _schedule_for(self, spec: JobSpec) -> List[int]:
+        return chunk_schedule(
+            spec.sim_ms, spec.chunk_ms, self.horizon_quantum_ms
+        )
+
+    def _is_chunked(self, spec: JobSpec) -> bool:
+        """Chunked execution: explicit chunkMs, or the scheduler
+        quantum covers this horizon (sim_ms == quantum is one unit of
+        the SHARED chunked family, not a private direct program)."""
+        return bool(spec.chunk_ms) or (
+            self.horizon_quantum_ms > 0
+            and spec.sim_ms >= self.horizon_quantum_ms
+        )
+
     def pre_key(self, spec: JobSpec) -> str:
         """Compatibility pre-key from the spec alone (no engine build):
-        protocol + traced params + horizon + chunk schedule + telemetry
-        geometry.  Jobs sharing it are CANDIDATES for one batch; the
+        protocol + traced params + execution geometry + telemetry
+        config.  Chunked jobs key on the chunk UNIT, not the horizon —
+        horizon sharding packs mixed-simMs tenants into one family.
+        Jobs sharing the pre-key are CANDIDATES for one batch; the
         family build extends it with the template leaf signature."""
         entry = serve_protocol(spec.protocol)
         traced = {
@@ -208,13 +329,19 @@ class BatchScheduler:
             for k in sorted(spec.params)
             if k not in entry.state_only
         }
+        schedule = self._schedule_for(spec)
+        chunked = self._is_chunked(spec)
+        horizon = (
+            {"mode": "chunked", "unit_ms": schedule[0]}
+            if chunked
+            else {"mode": "direct", "sim_ms": spec.sim_ms}
+        )
         payload = json.dumps(
             {
                 "protocol": spec.protocol,
                 "traced": traced,
-                "sim_ms": spec.sim_ms,
-                "chunk_ms": spec.chunk_ms,
                 "snapshots": self.telemetry_snapshots,
+                **horizon,
             },
             sort_keys=True,
             default=str,
@@ -223,11 +350,30 @@ class BatchScheduler:
             payload.encode(), digest_size=8
         ).hexdigest()
 
-    def retry_after_s(self) -> int:
-        """Seconds until queued work likely drains one batch slot, from
-        the EMA batch wall time (RFC 9110: >= 1)."""
-        batches_ahead = self.queue.depth() // self.max_batch_replicas + 1
-        return max(1, int(batches_ahead * self._ema_batch_s + 0.5))
+    def retry_after_s(self, compat: Optional[str] = None) -> int:
+        """Seconds until queued work likely drains one batch slot (RFC
+        9110: >= 1).  With a known family the estimate is paced from
+        THAT family's batch-time EMA over THAT family's backlog; the
+        global EMA over the whole queue is the cold/unknown fallback."""
+        if compat is not None and compat in self._ema_family:
+            ema = self._ema_family[compat]
+            depth = self.queue.depth_for(compat)
+        else:
+            ema = self._ema_batch_s
+            depth = self.queue.depth()
+        batches_ahead = depth // self.max_batch_replicas + 1
+        # families drain concurrently across lanes: the wait shortens by
+        # the wave width the fleet can actually sustain
+        lanes = max(1, self.device_groups)
+        return max(1, int(batches_ahead * ema / lanes + 0.5))
+
+    def _note_batch_time(self, compat: Optional[str], dt: float) -> None:
+        self._ema_batch_s = 0.5 * self._ema_batch_s + 0.5 * dt
+        if compat:
+            prev = self._ema_family.get(compat)
+            self._ema_family[compat] = (
+                dt if prev is None else 0.5 * prev + 0.5 * dt
+            )
 
     def submit(self, spec_dict: dict) -> Job:
         """Parse, validate, and enqueue one job (raises ValueError /
@@ -238,7 +384,9 @@ class BatchScheduler:
         job = Job(spec=spec, compat=self.pre_key(spec),
                   priority=spec.priority)
         try:
-            self.queue.submit(job, retry_after_s=self.retry_after_s())
+            self.queue.submit(
+                job, retry_after_s=self.retry_after_s(job.compat)
+            )
         except QueueFullError as e:
             self.recorder.record(
                 "admission-rejected", ctx=_job_ctx(job),
@@ -250,6 +398,7 @@ class BatchScheduler:
             "admission", ctx=_job_ctx(job),
             protocol=spec.protocol, compat=job.compat,
             sim_ms=spec.sim_ms, chunk_ms=spec.chunk_ms or None,
+            schedule_units=len(self._schedule_for(spec)),
             priority=spec.priority or None,
             queue_depth=self.queue.depth(),
         )
@@ -259,8 +408,9 @@ class BatchScheduler:
         return job
 
     def submit_legacy(self, thunk, priority: int = 0) -> Job:
-        """Queue an opaque host-side thunk (the rerouted /w/sweep): it
-        occupies one worker turn and is never packed with batch jobs."""
+        """Queue an opaque host-side thunk (the rerouted /w/sweep and
+        the legacy runMs gateway): it occupies one lane turn and is
+        never packed with batch jobs."""
         job = Job(spec=None, compat="", kind="legacy", thunk=thunk,
                   priority=priority)
         job.compat = f"legacy-{job.id}"
@@ -292,10 +442,17 @@ class BatchScheduler:
             from ..runtime.supervisor import stable_run_key
             from ..telemetry import TelemetryConfig
 
+            schedule = self._schedule_for(spec)
+            chunked = self._is_chunked(spec)
+            unit = schedule[0]
             snaps = self.telemetry_snapshots
+            # snapshot cadence must derive from the family's traced
+            # geometry: the chunk UNIT for chunked families (whose
+            # members disagree on sim_ms), the horizon for direct ones
+            cadence_ms = unit if chunked else spec.sim_ms
             tele_cfg = TelemetryConfig(
                 snapshots=snaps,
-                snapshot_every_ms=max(1, spec.sim_ms // max(1, snaps)),
+                snapshot_every_ms=max(1, cadence_ms // max(1, snaps)),
             )
             entry = serve_protocol(spec.protocol)
             net, state = entry.build(spec.params, tele_cfg)
@@ -303,15 +460,18 @@ class BatchScheduler:
             # schedule (bit-identical by the SL406 contract), so one
             # program serves faulted and clean rows alike
             net, state = net.with_faults(state)
-            n_chunks = (
-                spec.sim_ms // spec.chunk_ms if spec.chunk_ms else 1
-            )
+            # chunked families span horizons, so the digest carries the
+            # unit (n_chunks=0 marks "variable"); direct families keep
+            # the single-call geometry
             digest = key + "/" + stable_run_key(
-                net, state, n_chunks, spec.chunk_ms or spec.sim_ms
+                net, state, 0 if chunked else 1,
+                unit if chunked else spec.sim_ms,
             )
             fam = ScenarioFamily(
                 key, digest, net, entry, tele_cfg, spec.sim_ms,
-                spec.chunk_ms, self._params_key(spec.params), state,
+                unit if chunked else 0, self._params_key(spec.params),
+                state, mode="chunked" if chunked else "direct",
+                unit_ms=unit,
             )
             self._families[key] = fam
             return fam
@@ -378,10 +538,12 @@ class BatchScheduler:
         """Reference result for one spec: a 1-row stack through the
         engine directly (no packing, no run cache).  The multi-tenant
         contract is that every batched job's result digest equals this
-        — rows of a vmapped run are lane-independent.  A chunked spec
-        replays the SAME chunk schedule: the sim state is schedule-
-        independent, but the telemetry loop census (jumps cannot cross
-        a chunk boundary) is part of the digested side-car."""
+        — rows of a vmapped run are lane-independent.  A chunked or
+        horizon-sharded spec replays the SAME chunk schedule
+        (jobs.chunk_schedule — one source of truth with the batched
+        path): the sim state is schedule-independent, but the telemetry
+        loop census (jumps cannot cross a chunk boundary) is part of
+        the digested side-car."""
         import jax
 
         from ..engine import stack_states
@@ -395,8 +557,7 @@ class BatchScheduler:
             [spec.plan], fam.net.n_nodes, fam.net.protocol.n_msg_types()
         )
         out = stacked._replace(faults=fs)
-        step = spec.chunk_ms or spec.sim_ms
-        for _ in range(spec.sim_ms // step):
+        for step in self._schedule_for(spec):
             out = fam.net.run_ms_batched(out, step)
         single = jax.tree_util.tree_map(lambda a: a[0], out)
         return self._row_result(fam, single)
@@ -433,37 +594,105 @@ class BatchScheduler:
 
     # -- dispatch ------------------------------------------------------
 
-    def drain_once(self) -> bool:
-        """One scheduling decision: resume the best parked batch or
-        dispatch the best pending group.  Returns False when idle.
-        Deterministic entry point for tests; the worker loop just calls
-        this."""
-        parked = max(
-            self._parked, key=lambda b: (b.priority, -b.started),
-            default=None,
-        )
-        best = self.queue.best_pending()
-        if parked is not None and (
-            best is None or best.priority <= parked.priority
-        ):
-            return self._continue_parked(parked)
-        if best is None:
+    def _lane_obj(self, lane: Optional[int]) -> _Lane:
+        return self._lanes[0 if lane is None else lane]
+
+    def _claimable_pending(self, lane_idx: int) -> Optional[Job]:
+        """Best pending job this lane may run: legacy thunks run
+        anywhere; batch jobs only where their family is (or can be)
+        bound.  Caller holds the dispatch lock."""
+        best = None
+        for j in self.queue.pending_snapshot():
+            bound = self._family_lane.get(j.compat)
+            if j.kind != "legacy" and bound is not None and bound != lane_idx:
+                continue
+            if best is None or (j.priority, -j.seq) > (
+                best.priority, -best.seq
+            ):
+                best = j
+        return best
+
+    def _claim(self, lane: _Lane):
+        """One scheduling decision for one lane, under the dispatch
+        lock: resume this lane's best parked batch or pop the best
+        claimable pending group (binding its family to the lane).
+        Returns ("parked", batch) | ("legacy", job) | ("jobs", jobs) |
+        None."""
+        with self._dispatch_lock:
+            parked = max(
+                (
+                    b
+                    for b in self._parked
+                    if not b.running and b.lane == lane.index
+                ),
+                key=lambda b: (b.priority, -b.started),
+                default=None,
+            )
+            best = self._claimable_pending(lane.index)
+            if parked is not None and (
+                best is None or best.priority <= parked.priority
+            ):
+                parked.running = True
+                self._mark_busy(lane)
+                return ("parked", parked)
+            if best is None:
+                return None
+            if parked is not None and best.priority > parked.priority:
+                if not parked.preempted:
+                    parked.preempted = True
+                    self.metrics.observe_preemption()
+            jobs = self.queue.take_batch(
+                best.compat,
+                1 if best.kind == "legacy" else self.max_batch_replicas,
+            )
+            if not jobs:
+                return None
+            if best.kind == "legacy":
+                self._mark_busy(lane)
+                return ("legacy", jobs[0])
+            # sticky family→lane binding: placement is part of the
+            # compiled program's signature, so a family that wandered
+            # across lanes would compile once per lane
+            self._family_lane.setdefault(best.compat, lane.index)
+            self._mark_busy(lane)
+            return ("jobs", jobs)
+
+    def _mark_busy(self, lane: _Lane) -> None:
+        """Caller holds the dispatch lock.  Wave width = lanes busy the
+        instant this dispatch starts (this lane included)."""
+        lane.busy = True
+        lane.dispatches += 1
+        self._active_dispatches += 1
+        width = sum(1 for l in self._lanes if l.busy)
+        self.metrics.observe_wave(lane.index, width)
+
+    def _mark_idle(self, lane: _Lane, t0: float) -> None:
+        with self._dispatch_lock:
+            lane.busy = False
+            lane.busy_seconds += time.monotonic() - t0
+            self._active_dispatches -= 1
+
+    def drain_once(self, lane: Optional[int] = None) -> bool:
+        """One scheduling decision on one lane (default: lane 0 — the
+        deterministic entry point tests drive; each lane's worker loop
+        calls this with its own index).  Returns False when this lane
+        has nothing claimable."""
+        lane_obj = self._lane_obj(lane)
+        claim = self._claim(lane_obj)
+        if claim is None:
             return False
-        if parked is not None and best.priority > parked.priority:
-            if not parked.preempted:
-                parked.preempted = True
-                self.metrics.observe_preemption()
-        jobs = self.queue.take_batch(
-            best.compat,
-            1 if best.kind == "legacy" else self.max_batch_replicas,
-        )
-        if not jobs:
-            return False
-        if best.kind == "legacy":
-            self._run_legacy(jobs[0])
+        kind, item = claim
+        t0 = time.monotonic()
+        try:
+            if kind == "parked":
+                return self._continue_parked(item)
+            if kind == "legacy":
+                self._run_legacy(item)
+                return True
+            self._dispatch(item, lane_obj)
             return True
-        self._dispatch(jobs)
-        return True
+        finally:
+            self._mark_idle(lane_obj, t0)
 
     def _finish_job(self, job: Job, state: JobState, **kw) -> None:
         job.finish(state, **kw)
@@ -486,7 +715,7 @@ class BatchScheduler:
             return
         self._finish_job(job, JobState.DONE, result=result)
 
-    def _dispatch(self, jobs: List[Job]) -> None:
+    def _dispatch(self, jobs: List[Job], lane: _Lane) -> None:
         live = []
         for j in jobs:
             if j.cancel_requested:
@@ -511,8 +740,10 @@ class BatchScheduler:
                     error=f"{type(e).__name__}: {e}", exc=e,
                 )
             return
-        self._batch_seq += 1
-        batch_id = f"batch-{self._batch_seq:05d}"
+        with self._dispatch_lock:
+            self._batch_seq += 1
+            batch_id = f"batch-{self._batch_seq:05d}"
+            wave_width = sum(1 for l in self._lanes if l.busy)
         now = time.monotonic()
         for j in live:
             j.state = JobState.RUNNING
@@ -525,13 +756,16 @@ class BatchScheduler:
         self.recorder.record(
             "pack", ctx=batch_ctx, batch_id=batch_id,
             compat=live[0].compat, family_digest=fam.digest,
-            mode="chunked" if fam.chunk_ms else "direct",
+            mode=fam.mode,
+            lane=lane.index,
+            wave_width=wave_width,
             members=[
                 {
                     "job_id": j.id,
                     "run_id": j.run_id,
                     "tenant": j.spec.tenant,
                     "replica": i,
+                    "sim_ms": j.spec.sim_ms,
                 }
                 for i, j in enumerate(live)
             ],
@@ -539,14 +773,25 @@ class BatchScheduler:
             padding_rows=self.max_batch_replicas - len(live),
             capacity=self.max_batch_replicas,
         )
-        if fam.chunk_ms:
-            self._start_chunked(batch_id, fam, live, stacked, batch_ctx)
+        if fam.mode == "chunked":
+            self._start_chunked(
+                batch_id, fam, live, stacked, batch_ctx, lane
+            )
         else:
-            self._dispatch_direct(batch_id, fam, live, stacked, batch_ctx)
+            self._dispatch_direct(
+                batch_id, fam, live, stacked, batch_ctx, lane
+            )
 
-    def _dispatch_direct(self, batch_id, fam, jobs, stacked, ctx=None) -> None:
+    def _dispatch_direct(
+        self, batch_id, fam, jobs, stacked, ctx=None, lane=None
+    ) -> None:
         from ..parallel.replica_shard import sharded_run_stats
 
+        if lane is not None and lane.group is not None:
+            # commit the batch to this lane's devices: wave packing's
+            # concurrency comes from different lanes running on
+            # disjoint device groups
+            stacked = lane.group.place(stacked)
         t0 = time.monotonic()
         try:
             out, _stats = sharded_run_stats(fam.net, stacked, fam.sim_ms)
@@ -564,31 +809,48 @@ class BatchScheduler:
             return
         finally:
             dt = time.monotonic() - t0
-            self._ema_batch_s = 0.5 * self._ema_batch_s + 0.5 * dt
+            self._note_batch_time(jobs[0].compat if jobs else None, dt)
             self.metrics.observe_batch(
                 len(jobs), self.max_batch_replicas, dt
             )
 
-    def _start_chunked(self, batch_id, fam, jobs, stacked, ctx=None) -> None:
+    def _start_chunked(
+        self, batch_id, fam, jobs, stacked, ctx=None, lane=None
+    ) -> None:
         from ..parallel.replica_shard import _run_and_reduce
         from ..runtime.supervisor import Supervisor, stable_run_key
 
-        n_chunks = fam.sim_ms // fam.chunk_ms
+        unit = fam.unit_ms
+        # horizon sharding: every member advances in the same fixed
+        # units; its OWN chunk count (and quantum remainder) decides
+        # when its row is captured
+        job_chunks = [max(1, j.spec.sim_ms // unit) for j in jobs]
+        job_rems = [
+            j.spec.sim_ms % unit if j.spec.sim_ms > unit else 0
+            for j in jobs
+        ]
+        n_chunks = max(job_chunks)
         ckpt_dir = os.path.join(self.checkpoint_root, batch_id)
         # the chunk function goes through the run cache too: chunked
         # mode costs ONE extra compile per family, not one per slice
-        cached = _run_and_reduce(fam.net, fam.chunk_ms)
+        cached = _run_and_reduce(fam.net, unit)
+        placement = (
+            lane.group.place
+            if lane is not None and lane.group is not None
+            else None
+        )
         sup = Supervisor(
             lambda s: cached(s)[0],
             stacked,
             n_chunks=n_chunks,
-            chunk_ms=fam.chunk_ms,
+            chunk_ms=unit,
             checkpoint_dir=ckpt_dir,
             checkpoint_every=1,
-            run_key=stable_run_key(fam.net, stacked, n_chunks, fam.chunk_ms),
+            run_key=stable_run_key(fam.net, stacked, n_chunks, unit),
             max_chunks_this_run=self.slice_chunks,
             ctx=ctx,
             recorder=self.recorder,
+            placement=placement,
             run_meta={
                 "batch_id": batch_id,
                 "members": [
@@ -601,48 +863,133 @@ class BatchScheduler:
         parked = _ParkedBatch(
             batch_id, fam, jobs, sup, ckpt_dir,
             max(j.priority for j in jobs), self.max_batch_replicas,
+            lane=lane.index if lane is not None else 0,
+            job_chunks=job_chunks, job_rems=job_rems,
         )
-        self._parked.append(parked)
+        parked.running = True
+        with self._dispatch_lock:
+            self._parked.append(parked)
         self._continue_parked(parked)
 
     def _continue_parked(self, parked: _ParkedBatch) -> bool:
-        if parked.preempted:
-            parked.preempted = False
-            self.metrics.observe_resume()
-        if all(j.cancel_requested for j in parked.jobs):
-            for j in parked.jobs:
-                self._finish_job(j, JobState.CANCELLED)
-            self._drop_parked(parked)
-            return True
-        t0 = time.monotonic()
         try:
-            report = parked.supervisor.run()
-        except BaseException as e:  # noqa: BLE001 — supervised failure
-            # the supervisor already recorded + dumped its black box;
-            # this event marks the batch-level consequence
-            self.recorder.record(
-                "batch-failed", ctx=parked.supervisor.ctx,
-                batch_id=parked.batch_id,
-                error=f"{type(e).__name__}: {e}"[:500],
+            if parked.preempted:
+                parked.preempted = False
+                self.metrics.observe_resume()
+            if all(j.cancel_requested for j in parked.jobs):
+                for j in parked.jobs:
+                    if j.id not in parked.finished:
+                        self._finish_job(j, JobState.CANCELLED)
+                self._drop_parked(parked)
+                return True
+            # stop exactly at the next member horizon boundary (where
+            # finished rows are captured) without exceeding the
+            # preemption slice
+            next_boundary = min(
+                (c for c in parked.job_chunks if c > parked.chunks_done),
+                default=parked.supervisor.n_chunks,
             )
-            for j in parked.jobs:
+            parked.supervisor.max_chunks_this_run = min(
+                self.slice_chunks, next_boundary - parked.chunks_done
+            )
+            t0 = time.monotonic()
+            try:
+                report = parked.supervisor.run()
+            except BaseException as e:  # noqa: BLE001 — supervised failure
+                # the supervisor already recorded + dumped its black
+                # box; this event marks the batch-level consequence
+                self.recorder.record(
+                    "batch-failed", ctx=parked.supervisor.ctx,
+                    batch_id=parked.batch_id,
+                    error=f"{type(e).__name__}: {e}"[:500],
+                )
+                for j in parked.jobs:
+                    if j.id not in parked.finished:
+                        self._finish_job(
+                            j, JobState.FAILED,
+                            error=f"{type(e).__name__}: {e}", exc=e,
+                        )
+                self._drop_parked(parked)
+                return True
+            dt = time.monotonic() - t0
+            self._note_batch_time(parked.family.key, dt)
+            self.metrics.observe_batch(
+                len(parked.jobs), parked.capacity, dt
+            )
+            parked.chunks_done = report.chunks_done
+            self._stream_progress(parked, report.state)
+            self._capture_finished(parked, report.state)
+            if report.ok or len(parked.finished) == len(parked.jobs):
+                self._drop_parked(parked)
+            # otherwise: a controlled partial stop — the batch stays
+            # parked (checkpoint on disk) and this lane's next
+            # drain_once decides whether it continues or yields to
+            # higher-priority work
+            return True
+        finally:
+            parked.running = False
+
+    def _capture_finished(self, parked: _ParkedBatch, stacked) -> None:
+        """Finalize every member whose horizon boundary is the current
+        chunk count: capture its row from the batch state, run any
+        quantum remainder on a 1-row stack (the singleton replays the
+        identical [unit]*k + [rem] schedule), and finish the job while
+        the batch runs on for longer-horizon members."""
+        import jax
+
+        fam = parked.family
+        finishing = [
+            i
+            for i, c in enumerate(parked.job_chunks)
+            if c == parked.chunks_done
+            and parked.jobs[i].id not in parked.finished
+        ]
+        if not finishing:
+            return
+        attrib = self._attribution(fam, parked.jobs, stacked)
+        for i in finishing:
+            job = parked.jobs[i]
+            parked.finished.add(job.id)
+            if job.cancel_requested:
+                self._finish_job(job, JobState.CANCELLED)
+                continue
+            rem = parked.job_rems[i]
+            try:
+                if rem:
+                    row = self._run_remainder(fam, stacked, i, rem)
+                else:
+                    row = jax.tree_util.tree_map(
+                        lambda a, i=i: a[i], stacked
+                    )
+                result = self._row_result(fam, row)
+            except BaseException as e:  # noqa: BLE001 — row finalization
                 self._finish_job(
-                    j, JobState.FAILED,
+                    job, JobState.FAILED,
                     error=f"{type(e).__name__}: {e}", exc=e,
                 )
-            self._drop_parked(parked)
-            return True
-        dt = time.monotonic() - t0
-        self._ema_batch_s = 0.5 * self._ema_batch_s + 0.5 * dt
-        self.metrics.observe_batch(len(parked.jobs), parked.capacity, dt)
-        self._stream_progress(parked, report.state)
-        if report.ok:
-            self._finalize(parked.family, parked.jobs, report.state)
-            self._drop_parked(parked)
-        # ok=False: a controlled partial stop — the batch stays parked
-        # (checkpoint on disk) and the next drain_once decides whether
-        # it continues or yields to higher-priority work
-        return True
+                continue
+            job.progress = result["progress"]
+            if attrib is not None:
+                job.attribution = self._job_attribution(attrib, job)
+                result["attribution"] = job.attribution
+                self.metrics.observe_tenant(
+                    job.spec.tenant, attrib["jobs"].get(job.id)
+                )
+            self._finish_job(job, JobState.DONE, result=result)
+
+    def _run_remainder(self, fam: ScenarioFamily, stacked, i: int,
+                       rem_ms: int):
+        """A quantum remainder (sim_ms % unit) for one captured row: a
+        1-row stack through the run cache — the tail of the same chunk
+        schedule the singleton replays.  Costs one small compiled
+        program per distinct remainder length."""
+        import jax
+
+        from ..parallel.replica_shard import _run_and_reduce
+
+        row1 = jax.tree_util.tree_map(lambda a, i=i: a[i : i + 1], stacked)
+        out, _stats = _run_and_reduce(fam.net, rem_ms)(row1)
+        return jax.tree_util.tree_map(lambda a: a[0], out)
 
     def _stream_progress(self, parked: _ParkedBatch, stacked) -> None:
         from ..telemetry.export import progress_series
@@ -662,8 +1009,9 @@ class BatchScheduler:
                 self.metrics.observe_ttfr(job)
 
     def _drop_parked(self, parked: _ParkedBatch) -> None:
-        if parked in self._parked:
-            self._parked.remove(parked)
+        with self._dispatch_lock:
+            if parked in self._parked:
+                self._parked.remove(parked)
         shutil.rmtree(parked.ckpt_dir, ignore_errors=True)
 
     # -- attribution ----------------------------------------------------
@@ -715,35 +1063,44 @@ class BatchScheduler:
                 )
             self._finish_job(job, JobState.DONE, result=result)
 
-    # -- worker --------------------------------------------------------
+    # -- workers --------------------------------------------------------
 
     def start(self) -> None:
         # auto_start means every submit calls this: a burst of first
-        # requests races the is_alive check and, unguarded, each spawns
-        # its own (identically named) worker — concurrent workers then
-        # duplicate batch compiles.  ONE worker is the design.
+        # requests races the is_alive checks and, unguarded, each
+        # spawns its own (identically named) workers — concurrent
+        # workers on ONE lane then duplicate batch compiles.  One
+        # worker per lane is the design; the dispatch lock serializes
+        # their claims.
         with self._worker_lock:
-            if self._worker is not None and self._worker.is_alive():
-                return
             self._stop.clear()
-            self._worker = threading.Thread(
-                target=self._loop, daemon=True, name="witt-serve-worker"
-            )
-            self._worker.start()
+            for lane in self._lanes:
+                if lane.thread is not None and lane.thread.is_alive():
+                    continue
+                lane.thread = threading.Thread(
+                    target=self._loop, args=(lane.index,), daemon=True,
+                    name=f"witt-serve-lane-{lane.index}",
+                )
+                lane.thread.start()
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
         self.queue.notify()
         with self._worker_lock:
-            worker = self._worker
-            self._worker = None
-        if worker is not None:
-            worker.join(timeout)
+            threads = [
+                lane.thread
+                for lane in self._lanes
+                if lane.thread is not None
+            ]
+            for lane in self._lanes:
+                lane.thread = None
+        for t in threads:
+            t.join(timeout)
 
-    def _loop(self) -> None:
+    def _loop(self, lane_idx: int) -> None:
         while not self._stop.is_set():
             try:
-                if not self.drain_once():
+                if not self.drain_once(lane_idx):
                     self.queue.wait_for_work(timeout=0.2)
             except Exception:  # noqa: BLE001 — worker must not die
                 # per-job failures are reported on the jobs themselves;
@@ -752,7 +1109,9 @@ class BatchScheduler:
                 time.sleep(0.1)
 
     def busy(self) -> bool:
-        return bool(self._parked) or self.queue.depth() > 0
+        with self._dispatch_lock:
+            active = self._active_dispatches
+        return bool(self._parked) or self.queue.depth() > 0 or active > 0
 
     def wait_idle(self, timeout: float = 60.0) -> bool:
         deadline = time.monotonic() + timeout
@@ -772,6 +1131,10 @@ class BatchScheduler:
             "families": len(self._families),
             "maxBatchReplicas": self.max_batch_replicas,
             "retryAfterS": self.retry_after_s(),
+            "deviceGroups": self.device_groups,
+            "horizonQuantumMs": self.horizon_quantum_ms,
+            "lanes": [lane.describe() for lane in self._lanes],
+            "waveWidthMax": self.metrics.wave_width_max,
         }
 
     def add_prometheus(self, p) -> None:

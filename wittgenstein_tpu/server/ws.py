@@ -150,32 +150,17 @@ class WServer:
         self.degraded_reason = None
         return {"ok": True}
 
-    @route("POST", r"/w/network/runMs/(?P<ms>\d+)", locked=False)
-    def run_ms(self, body, ms):
-        """Sliced, interruptible, resumable advance.  NOT under the
-        shared lock wholesale: each RUN_SLICE_MS slice takes it, so
-        status/metrics reads interleave; busy and degraded backends get
-        503 + Retry-After instead of a queued request."""
-        ms = int(ms)
-        if self.degraded:
-            return Response(
-                {
-                    "error": f"backend degraded: {self.degraded_reason}",
-                    "degraded": True,
-                },
-                503,
-                {"Retry-After": "30"},
-            )
-        if not self.run_lock.acquire(blocking=False):
-            return Response(
-                {"error": "a runMs is already in progress", "busy": True},
-                503,
-                {"Retry-After": str(self._retry_after_s())},
-            )
+    def _run_ms_sliced(self, ms: int) -> dict:
+        """The sliced, interruptible runMs body — executed on a
+        scheduler lane (the handler thread only waits).  Each
+        RUN_SLICE_MS slice takes the shared lock, so status/metrics
+        reads interleave; the degraded latch is set HERE (inside the
+        executing thread) so a broken sim is latched even if the
+        waiting client has gone away."""
+        self._interrupt.clear()
+        self._run_started = time.monotonic()
+        self._run_ms_total = ms
         try:
-            self._interrupt.clear()
-            self._run_started = time.monotonic()
-            self._run_ms_total = ms
             done = 0
             t0 = time.monotonic()
             try:
@@ -219,6 +204,60 @@ class WServer:
                 }
         finally:
             self._run_started = None
+
+    @route("POST", r"/w/network/runMs/(?P<ms>\d+)", locked=False)
+    def run_ms(self, body, ms):
+        """Interactive advance, routed through the serve/ job queue like
+        every other unit of device work (ISSUE 13): the sliced loop runs
+        on a scheduler lane, so the fleet has ONE dispatch discipline —
+        a runMs takes a lane turn and is paced/preempted against batch
+        jobs instead of bypassing them on the handler thread.  The
+        handler semantics are unchanged: it blocks for the legacy
+        response shape, a second runMs gets 503 + Retry-After (the
+        run_lock is the busy latch), and a full queue answers 503 with
+        the scheduler's backpressure estimate."""
+        ms = int(ms)
+        if self.degraded:
+            return Response(
+                {
+                    "error": f"backend degraded: {self.degraded_reason}",
+                    "degraded": True,
+                },
+                503,
+                {"Retry-After": "30"},
+            )
+        if not self.run_lock.acquire(blocking=False):
+            return Response(
+                {"error": "a runMs is already in progress", "busy": True},
+                503,
+                {"Retry-After": str(self._retry_after_s())},
+            )
+        try:
+            try:
+                job = self.jobs.submit_legacy(
+                    lambda: self._run_ms_sliced(ms)
+                )
+            except QueueFullError as e:
+                return Response(
+                    {"error": "job queue full", "busy": True},
+                    503,
+                    {"Retry-After": str(e.retry_after_s)},
+                )
+            if not job.done_event.wait(600.0):
+                return Response(
+                    {"error": f"runMs job {job.id} did not finish "
+                              "within 600s", "jobId": job.id},
+                    503,
+                    {"Retry-After": str(self.jobs.retry_after_s())},
+                )
+            if job.state is JobState.FAILED:
+                # surface the original exception class so _invoke's
+                # status mapping (RuntimeError -> 409, ...) still holds
+                if job.exc is not None:
+                    raise job.exc
+                raise RuntimeError(job.error or "runMs failed")
+            return job.result
+        finally:
             self.run_lock.release()
 
     @route("POST", r"/w/network/interrupt", locked=False)
