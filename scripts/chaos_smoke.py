@@ -1,0 +1,251 @@
+"""Chaos smoke: one run, four injected faults, zero lost jobs.
+
+Phase A — fleet chaos against a LIVE scheduler (worker lanes running):
+
+  * a poison job rides inside a packed batch (the chaos injector raises
+    whenever the poison's row is in the dispatched subset — the
+    scheduler's bisection must isolate it, not be told);
+  * a lane thread is killed mid-workload (inject_lane_failure — the
+    real death → supervise → re-bind → restart path);
+  * every compile-store ``.bin`` payload is truncated mid-run
+    (vandalism: the store must fall back to fresh compiles, never
+    crash, never corrupt a result).
+
+  Asserted: every job reaches a TERMINAL state (zero lost jobs);
+  EXACTLY the poison job is quarantined, with the typed taxonomy kind
+  (``poison_row``); every other job's result digest is BITWISE
+  identical to its fault-free ``run_singleton`` reference; the lane
+  restarted at least once; the flight recorder holds the whole story
+  (lane-failed, lane-restart, salvage-start/run, quarantine,
+  salvage-done).
+
+Phase B — checkpoint corruption against a deterministic scheduler
+(auto_start=False, driven by drain_once):
+
+  * a chunked batch runs two slices (two checkpoints on disk), then
+    the NEWEST checkpoint file is truncated in place;
+  * the next slice's resume must walk past the corrupt file to the
+    older intact checkpoint (engine/checkpoint.restore_latest),
+    replay the lost chunk, and finish bitwise-identical to the
+    singleton reference.
+
+The flight-recorder ring is dumped into out_dir either way — on CI
+failure it ships as the forensics artifact.
+
+Usage: python scripts/chaos_smoke.py [out_dir]   (default ./chaos_smoke)
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+BASE = {"protocol": "PingPong", "params": {"node_ct": 32}, "simMs": 60}
+
+
+def _vandalize_store(store_dir: str) -> int:
+    """Truncate every compiled-program payload in place (manifests left
+    intact, so every get() sees a checksum mismatch, not a miss)."""
+    hit = 0
+    for path in glob.glob(os.path.join(store_dir, "*.bin")):
+        with open(path, "wb") as f:
+            f.write(b"vandalized")
+        hit += 1
+    return hit
+
+
+def phase_a(out_dir: str, failures: list) -> dict:
+    """Live-fleet chaos: poison + lane kill + compile-store vandalism."""
+    from wittgenstein_tpu.obs import FlightRecorder
+    from wittgenstein_tpu.runtime.compile_store import (
+        compile_store_counters,
+        set_compile_store,
+    )
+    from wittgenstein_tpu.serve import BatchScheduler, JobState
+    from wittgenstein_tpu.serve.jobs import TERMINAL
+
+    store_dir = tempfile.mkdtemp(prefix="witt_chaos_store_")
+    set_compile_store(store_dir)
+    recorder = FlightRecorder(
+        path=os.path.join(out_dir, "flight_recorder.jsonl")
+    )
+    sched = BatchScheduler(
+        auto_start=False, max_batch_replicas=4, recorder=recorder,
+        horizon_quantum_ms=0,
+    )
+
+    # wave 1 (pre-chaos): warm the family + populate the compile store
+    warm_spec = {**BASE, "seed": 90}
+    warm = sched.submit(warm_spec)
+    sched.start()
+    if not warm.done_event.wait(300):
+        failures.append("phase A: warm-up job timed out")
+        return {}
+
+    # mid-run vandalism: every payload the warm run published is now
+    # garbage — later fresh processes would fall back to fresh compiles
+    vandalized = _vandalize_store(store_dir)
+    store0 = compile_store_counters()
+
+    # wave 2: the chaos workload — 4 direct jobs, one of them poison
+    specs = [{**BASE, "seed": i} for i in range(4)]
+    jobs = [sched.submit(s) for s in specs]
+    poison = jobs[2]
+
+    def injector(fam, batch):
+        if any(j.id == poison.id for j in batch):
+            raise RuntimeError("chaos: poison row detonates the batch")
+
+    sched.chaos_injector = injector
+
+    # lane kill while the chaos wave is in flight
+    sched.inject_lane_failure(0)
+
+    deadline = time.monotonic() + 300
+    pending = [warm] + jobs
+    while time.monotonic() < deadline:
+        if all(j.state in TERMINAL for j in pending):
+            break
+        time.sleep(0.05)
+    sched.chaos_injector = None
+    sched.stop()
+
+    # -- assertions ---------------------------------------------------
+    non_terminal = [
+        j.id for j in pending if j.state not in TERMINAL
+    ]
+    if non_terminal:
+        failures.append(f"phase A: lost jobs (non-terminal): {non_terminal}")
+    quarantined = [j for j in pending if j.state is JobState.QUARANTINED]
+    if [j.id for j in quarantined] != [poison.id]:
+        failures.append(
+            "phase A: quarantine blamed the wrong rows: "
+            f"{[j.id for j in quarantined]} (expected [{poison.id}])"
+        )
+    if poison.error_kind != "poison_row":
+        failures.append(
+            f"phase A: poison errorKind = {poison.error_kind!r}, "
+            "expected 'poison_row'"
+        )
+    survivors = [
+        (j, s) for j, s in zip(jobs, specs) if j is not poison
+    ]
+    for j, s in survivors:
+        if j.state is not JobState.DONE:
+            failures.append(
+                f"phase A: survivor {j.id} ended {j.state.value}: {j.error}"
+            )
+            continue
+        ref = sched.run_singleton(s)
+        if j.result["digest"] != ref["digest"]:
+            failures.append(
+                f"phase A: survivor {j.id} digest diverged from its "
+                "fault-free singleton"
+            )
+    if sched.metrics.lane_restarts_total < 1:
+        failures.append("phase A: the killed lane never restarted")
+    kinds = {e["kind"] for e in recorder.events()}
+    for want in ("lane-failed", "lane-restart", "salvage-start",
+                 "salvage-run", "quarantine", "salvage-done"):
+        if want not in kinds:
+            failures.append(f"phase A: recorder missing {want!r} event")
+    store1 = compile_store_counters()
+    health = sched.health()
+    summary = {
+        "jobs": len(pending),
+        "quarantined": [j.id for j in quarantined],
+        "laneRestarts": sched.metrics.lane_restarts_total,
+        "laneFailures": sched.metrics.lane_failures_total,
+        "salvageRuns": sched.metrics.salvage_runs_total,
+        "storePayloadsVandalized": vandalized,
+        "storeCorrupt": store1["corrupt"] - store0["corrupt"],
+        "errorKinds": health["errorKinds"],
+    }
+    recorder.dump(os.path.join(out_dir, "flight_recorder_dump.jsonl"))
+    return summary
+
+
+def phase_b(out_dir: str, failures: list) -> dict:
+    """Checkpoint corruption: the parked batch's newest checkpoint is
+    truncated between slices; resume must fall back + replay."""
+    from wittgenstein_tpu.serve import BatchScheduler, JobState
+
+    sched = BatchScheduler(
+        auto_start=False, max_batch_replicas=4, slice_chunks=1,
+    )
+    spec = {**BASE, "seed": 11, "simMs": 200, "chunkMs": 50}
+    job = sched.submit(spec)
+    # two slices -> two checkpoints on disk
+    for _ in range(2):
+        if not sched.drain_once():
+            break
+    if not sched._parked:
+        failures.append("phase B: batch never parked (no checkpoints)")
+        return {}
+    ckpt_dir = sched._parked[0].ckpt_dir
+    ckpts = sorted(glob.glob(os.path.join(ckpt_dir, "ckpt_*.npz")))
+    if len(ckpts) < 2:
+        failures.append(
+            f"phase B: expected >= 2 checkpoints, found {len(ckpts)}"
+        )
+        return {}
+    newest = ckpts[-1]
+    with open(newest, "wb") as f:
+        f.write(b"corrupt")  # truncated + garbage: load must fail
+    while sched.drain_once():
+        pass
+    if job.state is not JobState.DONE:
+        failures.append(
+            f"phase B: job ended {job.state.value} after checkpoint "
+            f"corruption: {job.error}"
+        )
+        return {"checkpoints": len(ckpts)}
+    ref = sched.run_singleton(spec)
+    if job.result["digest"] != ref["digest"]:
+        failures.append(
+            "phase B: resumed-past-corruption result diverged from the "
+            "singleton reference"
+        )
+    return {
+        "checkpoints": len(ckpts),
+        "corrupted": os.path.basename(newest),
+        "digestMatch": job.result["digest"] == ref["digest"],
+    }
+
+
+def main() -> int:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "./chaos_smoke"
+    os.makedirs(out_dir, exist_ok=True)
+    failures: list = []
+
+    a = phase_a(out_dir, failures)
+    print(f"phase A (poison + lane kill + store vandalism): "
+          f"{json.dumps(a, sort_keys=True)}")
+    b = phase_b(out_dir, failures)
+    print(f"phase B (checkpoint corruption): {json.dumps(b, sort_keys=True)}")
+
+    summary = {"ok": not failures, "failures": failures,
+               "phaseA": a, "phaseB": b}
+    with open(os.path.join(out_dir, "chaos_summary.json"), "w") as f:
+        json.dump(summary, f, indent=2, sort_keys=True)
+    if failures:
+        print("CHAOS SMOKE FAILED:")
+        for msg in failures:
+            print(f"  - {msg}")
+        return 1
+    print(f"chaos smoke OK — summary + recorder dump in {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

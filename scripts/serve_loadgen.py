@@ -220,6 +220,13 @@ def _fleet_run(specs, device_groups: int) -> dict:
         },
         "waveWidthMax": m.wave_width_max,
         "laneDispatches": dict(m._lane_dispatches),
+        "resilience": {
+            "quarantined": m.jobs_quarantined,
+            "laneFailures": m.lane_failures_total,
+            "laneRestarts": m.lane_restarts_total,
+            "salvageRuns": m.salvage_runs_total,
+            "salvageSeconds": round(m.salvage_seconds_total, 4),
+        },
         "occupancyAvg": round(
             m.replicas_packed_total / m.replicas_capacity_total, 4
         ) if m.replicas_capacity_total else 0.0,
@@ -262,6 +269,24 @@ def fleet_bench(device_groups: int, per_family: int,
         )
     for run in (serial, wave):
         run.pop("digests")  # bulky; identity already asserted
+    # a clean benchmark run pays ZERO resilience tax; any quarantine,
+    # lane restart, or salvage re-run here is itself a regression, and
+    # salvageSeconds/wallS is the overhead fraction trend CI watches
+    resilience = {
+        k: serial["resilience"][k] + wave["resilience"][k]
+        for k in serial["resilience"]
+    }
+    resilience["salvageSeconds"] = round(resilience["salvageSeconds"], 4)
+    total_wall = serial["wallS"] + wave["wallS"]
+    resilience["salvageOverheadFrac"] = round(
+        resilience["salvageSeconds"] / total_wall, 4
+    ) if total_wall else 0.0
+    if resilience["quarantined"] or resilience["laneRestarts"]:
+        failures.append(
+            f"resilience machinery fired during a fault-free benchmark "
+            f"(quarantined={resilience['quarantined']}, "
+            f"laneRestarts={resilience['laneRestarts']})"
+        )
     return {
         "schema": "witt-bench-serve/v1",
         "ok": not failures,
@@ -275,6 +300,7 @@ def fleet_bench(device_groups: int, per_family: int,
         },
         "serial": serial,
         "wave": wave,
+        "resilience": resilience,
         "speedup": round(speedup, 4),
         "minSpeedup": min_speedup,
         "speedupGateArmed": bool(min_speedup),
@@ -468,6 +494,7 @@ def main() -> int:
         failures.extend(bench.get("failures", []))
         slo["fleet"] = {k: bench.get(k) for k in (
             "ok", "speedup", "minSpeedup", "bitwiseIdentical",
+            "resilience",
         )}
         slo["ok"] = not failures
 

@@ -534,3 +534,116 @@ class TestSupervisorValidation:
         ).run()
         assert not rep.ok
         assert 0 < rep.chunks_done < 50
+
+
+class TestErrorTaxonomyExtensions:
+    """ISSUE 14: poison_row / lane_failed as first-class taxonomy
+    kinds, counted process-wide for the /w/health errorKinds surface."""
+
+    def test_new_kinds_classify_first(self):
+        from wittgenstein_tpu.runtime import LaneFailedError, PoisonRowError
+
+        perr = PoisonRowError("job-1", ValueError("bad row"))
+        assert classify(perr) == "poison_row"
+        assert "job-1" in str(perr)
+        lerr = LaneFailedError(2, "injected kill")
+        assert classify(lerr) == "lane_failed"
+        assert lerr.lane == 2
+
+    def test_retryable_kinds_gate(self):
+        from wittgenstein_tpu.runtime import (
+            RETRYABLE_KINDS,
+            LaneFailedError,
+            PoisonRowError,
+        )
+
+        assert "transient" in RETRYABLE_KINDS
+        assert "device_lost" in RETRYABLE_KINDS
+        # poison rows and fatal errors must never be silently retried
+        assert classify(PoisonRowError("j", ValueError("x"))) not in (
+            RETRYABLE_KINDS
+        )
+        assert classify(FatalRunError("no")) not in RETRYABLE_KINDS
+        # a lane death is transient from the JOB's point of view (the
+        # fleet restarts the lane and the work re-runs elsewhere)
+        assert classify(LaneFailedError(0)) in RETRYABLE_KINDS
+
+    def test_taxonomy_counters_count_per_classify(self):
+        from wittgenstein_tpu.runtime import (
+            PoisonRowError,
+            reset_taxonomy_counters,
+            taxonomy_counters,
+        )
+
+        reset_taxonomy_counters()
+        classify(PoisonRowError("j", ValueError("x")))
+        classify(DeviceLostError("gone"))
+        classify(RuntimeError("server UNAVAILABLE"))
+        counts = taxonomy_counters()
+        assert counts["poison_row"] == 1
+        assert counts["device_lost"] == 1
+        assert counts["transient"] == 1
+        reset_taxonomy_counters()
+        assert taxonomy_counters() == {}
+
+    def test_supervisor_raises_poison_without_retry(self, tmp_path):
+        from wittgenstein_tpu.runtime import PoisonRowError
+
+        calls = {"n": 0}
+
+        def chunk(s):
+            calls["n"] += 1
+            raise PoisonRowError("job-x", RuntimeError("poison"))
+
+        sup = Supervisor(
+            chunk, toy_state(), n_chunks=3,
+            checkpoint_dir=str(tmp_path / "ck"),
+            retry=RetryPolicy(
+                max_attempts=3, backoff_base_s=0.0, jitter_frac=0.0,
+            ),
+        )
+        with pytest.raises(PoisonRowError):
+            sup.run()
+        assert calls["n"] == 1, "poison row must not be retried"
+
+
+class TestSupervisorShouldStop:
+    """ISSUE 14: cooperative preemption hook — a drain stops the run at
+    the next chunk boundary as a controlled partial stop, and the
+    resumed run is bit-identical to an uninterrupted one."""
+
+    def test_stop_requested_parks_then_resume_completes(self, tmp_path):
+        stop = threading.Event()
+        ckdir = str(tmp_path / "ck")
+
+        def chunk_then_stop(s):
+            out = toy_chunk(s)
+            stop.set()  # drain arrives while the chunk is in flight
+            return out
+
+        sup = Supervisor(
+            chunk_then_stop, toy_state(), n_chunks=4,
+            checkpoint_dir=ckdir, checkpoint_every=1,
+            should_stop=stop.is_set,
+        )
+        report = sup.run()
+        assert report.ok is False  # controlled partial stop, not an error
+        assert report.chunks_done == 1  # stopped at the NEXT boundary
+        stop.clear()
+        sup2 = Supervisor(
+            toy_chunk, toy_state(), n_chunks=4, checkpoint_dir=ckdir,
+            checkpoint_every=1, should_stop=stop.is_set,
+        )
+        report2 = sup2.run()
+        assert report2.ok is True
+        assert_trees_equal(report2.state, toy_after(4))
+
+    def test_no_stop_runs_to_completion(self, tmp_path):
+        sup = Supervisor(
+            toy_chunk, toy_state(), n_chunks=3,
+            checkpoint_dir=str(tmp_path / "ck"),
+            should_stop=lambda: False,
+        )
+        report = sup.run()
+        assert report.ok is True
+        assert_trees_equal(report.state, toy_after(3))

@@ -11,6 +11,7 @@ chunked preemption/resume path are pinned alongside.
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -436,3 +437,247 @@ class TestRetryAfterPacing:
         sched._note_batch_time(a.compat, 4.0)
         # 1 pending / capacity 1 -> 1 batch ahead at ~4s/batch
         assert sched.retry_after_s(a.compat) <= sched.retry_after_s()
+
+
+class TestResilience:
+    """ISSUE 14: poison-job quarantine + batch salvage — a failed packed
+    batch is bisected, the poison row gets a terminal 4xx-style
+    disposition, and every survivor's re-run is bitwise-identical to its
+    singleton."""
+
+    def _poison_injector(self, poison_id):
+        def injector(fam, jobs):
+            if any(j.id == poison_id for j in jobs):
+                raise RuntimeError(f"chaos: poison row {poison_id}")
+        return injector
+
+    def test_direct_batch_salvage_quarantines_poison(self):
+        sched = BatchScheduler(auto_start=False, max_batch_replicas=4)
+        specs = [{**BASE, "seed": i} for i in range(4)]
+        jobs = [sched.submit(s) for s in specs]
+        sched.chaos_injector = self._poison_injector(jobs[2].id)
+        while sched.drain_once():
+            pass
+        assert jobs[2].state is JobState.QUARANTINED
+        assert jobs[2].error_kind == "poison_row"
+        assert jobs[2].to_dict()["errorKind"] == "poison_row"
+        for j, s in zip(jobs, specs):
+            if j is jobs[2]:
+                continue
+            assert j.state is JobState.DONE, (s, j.error)
+            assert j.result["digest"] == sched.run_singleton(s)["digest"], s
+        assert sched.metrics.jobs_quarantined == 1
+        assert sched.metrics.salvage_batches_total == 1
+        assert sched.metrics.salvage_runs_total >= 2
+
+    def test_chunked_batch_salvage_quarantines_poison(self):
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=4, horizon_quantum_ms=20,
+        )
+        specs = [
+            {**BASE, "seed": 0, "simMs": 40},
+            {**BASE, "seed": 1, "simMs": 60},
+            {**BASE, "seed": 2, "simMs": 50},  # quantum remainder rides too
+        ]
+        jobs = [sched.submit(s) for s in specs]
+        sched.chaos_injector = self._poison_injector(jobs[1].id)
+        while sched.drain_once():
+            pass
+        assert jobs[1].state is JobState.QUARANTINED
+        assert jobs[1].error_kind == "poison_row"
+        for j, s in zip(jobs, specs):
+            if j is jobs[1]:
+                continue
+            assert j.state is JobState.DONE, (s, j.error)
+            assert j.result["digest"] == sched.run_singleton(s)["digest"], s
+
+    def test_row_build_failure_quarantines_only_the_bad_job(self):
+        sched = BatchScheduler(auto_start=False, max_batch_replicas=4)
+        good = sched.submit({**BASE, "seed": 0})
+        bad = sched.submit({**BASE, "seed": 1})
+        orig = sched._row
+
+        def sabotage(fam, spec):
+            if spec.seed == 1:
+                raise ValueError("chaos: row build refuses seed 1")
+            return orig(fam, spec)
+
+        sched._row = sabotage
+        assert sched.drain_once()
+        assert bad.state is JobState.QUARANTINED
+        assert bad.error_kind == "poison_row"
+        assert good.state is JobState.DONE, good.error
+
+    def test_salvage_disabled_fails_whole_batch(self):
+        from wittgenstein_tpu.runtime import SalvagePolicy
+
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=4,
+            salvage=SalvagePolicy(enabled=False),
+        )
+        jobs = [sched.submit({**BASE, "seed": i}) for i in range(3)]
+        sched.chaos_injector = self._poison_injector(jobs[0].id)
+        while sched.drain_once():
+            pass
+        assert all(j.state is JobState.FAILED for j in jobs)
+        assert sched.metrics.salvage_batches_total == 0
+
+    def test_probe_budget_exhaustion_fails_honestly(self):
+        from wittgenstein_tpu.runtime import SalvagePolicy
+
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=4,
+            salvage=SalvagePolicy(max_probe_runs=0),
+        )
+        jobs = [sched.submit({**BASE, "seed": i}) for i in range(4)]
+        sched.chaos_injector = self._poison_injector(jobs[0].id)
+        while sched.drain_once():
+            pass
+        # zero probes allowed: nobody is salvaged, nobody is GUESSED
+        # into quarantine — all fail with the original batch error
+        assert all(j.state is JobState.FAILED for j in jobs)
+        assert not any(j.state is JobState.QUARANTINED for j in jobs)
+
+    def test_lane_failure_rebinds_and_restarts(self):
+        import time
+
+        sched = BatchScheduler(max_batch_replicas=4, auto_start=True)
+        warm_spec = {**BASE, "seed": 5}
+        warm = sched.submit(warm_spec)
+        assert warm.done_event.wait(300), "warm-up job timed out"
+        sched.inject_lane_failure(0)
+        deadline = time.monotonic() + 15
+        while (time.monotonic() < deadline
+               and sched.metrics.lane_restarts_total < 1):
+            time.sleep(0.02)
+        assert sched.metrics.lane_failures_total >= 1
+        assert sched.metrics.lane_restarts_total >= 1
+        # the restarted lane serves new work, bitwise as before
+        after_spec = {**BASE, "seed": 6}
+        after = sched.submit(after_spec)
+        assert after.done_event.wait(300), "post-restart job timed out"
+        sched.stop()
+        assert after.state is JobState.DONE, after.error
+        assert (after.result["digest"]
+                == sched.run_singleton(after_spec)["digest"])
+        assert sched.health()["errorKinds"].get("lane_failed", 0) >= 1
+
+    def test_on_lane_failure_rebinds_families_to_healthy_lane(self):
+        from wittgenstein_tpu.runtime import LaneFailedError
+
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=4, device_groups=2,
+        )
+        a = sched.submit({**BASE, "seed": 0})
+        assert sched.drain_once(0)
+        assert a.state is JobState.DONE, a.error
+        assert sched._family_lane[a.compat] == 0
+        # mark lane 1 alive without running real work on it
+        lane1 = sched._lanes[1]
+        lane1.thread = threading.Thread(target=lambda: time.sleep(2))
+        lane1.thread.start()
+        sched._on_lane_failure(sched._lanes[0], LaneFailedError(0, "test"))
+        assert sched._family_lane[a.compat] == 1
+        assert sched.metrics.lane_rebinds_total == 1
+        lane1.thread.join()
+        sched.stop()
+
+    def test_binding_expiry_reaps_idle_families(self):
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=4, binding_ttl_s=0.0,
+        )
+        a = sched.submit({**BASE, "seed": 0})
+        assert sched.drain_once()
+        assert a.state is JobState.DONE, a.error
+        assert a.compat in sched._family_lane
+        sched._reap_bindings()  # ttl 0: idle binding goes immediately
+        assert a.compat not in sched._family_lane
+        assert sched.metrics.bindings_expired_total == 1
+        # the family object (and its compiled program) survives expiry:
+        # the next job just re-binds a lane
+        b_spec = {**BASE, "seed": 1}
+        b = sched.submit(b_spec)
+        assert sched.drain_once()
+        assert b.state is JobState.DONE, b.error
+        assert b.result["digest"] == sched.run_singleton(b_spec)["digest"]
+
+    def test_binding_not_reaped_while_work_pending(self):
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=1, binding_ttl_s=0.0,
+        )
+        a = sched.submit({**BASE, "seed": 0})
+        b = sched.submit({**BASE, "seed": 1})
+        assert sched.drain_once()  # a done, b still queued
+        assert a.state is JobState.DONE, a.error
+        sched._reap_bindings()
+        assert b.compat in sched._family_lane, (
+            "binding reaped while jobs were still queued"
+        )
+
+
+class TestDrain:
+    """ISSUE 14 satellite: graceful drain — admission refuses with 503
+    semantics, in-flight chunked batches checkpoint-stop, and undrain
+    resumes bitwise-identical."""
+
+    def test_drain_blocks_admission_and_undrain_restores(self):
+        from wittgenstein_tpu.serve import DrainingError
+
+        sched = BatchScheduler(auto_start=False)
+        sched.drain()
+        with pytest.raises(DrainingError) as ei:
+            sched.submit({**BASE, "seed": 0})
+        assert ei.value.retry_after_s >= 1
+        with pytest.raises(DrainingError):
+            sched.submit_legacy(lambda: None)
+        assert sched.quiescent()
+        assert sched.metrics.drains_total == 1
+        sched.undrain()
+        job = sched.submit({**BASE, "seed": 0})
+        assert sched.drain_once()
+        assert job.state is JobState.DONE, job.error
+
+    def test_drain_mid_chunked_batch_resumes_bitwise_after_undrain(self):
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=4, slice_chunks=1,
+        )
+        spec = {**BASE, "seed": 3, "simMs": 200, "chunkMs": 50}
+        job = sched.submit(spec)
+        assert sched.drain_once()  # slice 1: batch parks, checkpointed
+        assert job.state is JobState.RUNNING
+        sched.drain()
+        # nothing claimable while draining: the parked batch stays
+        # checkpoint-parked, the job honestly RUNNING-but-parked
+        assert not sched.drain_once()
+        assert job.state is JobState.RUNNING
+        assert sched.quiescent()
+        assert len(sched._parked) == 1
+        sched.undrain()
+        while sched.drain_once():
+            pass
+        assert job.state is JobState.DONE, job.error
+        assert job.result["digest"] == sched.run_singleton(spec)["digest"]
+
+    def test_drain_stops_inflight_slice_at_chunk_boundary(self):
+        # with auto-started lanes: drain while the long batch is mid
+        # flight, wait for quiescence, then undrain and finish
+        sched = BatchScheduler(max_batch_replicas=4, slice_chunks=1,
+                               auto_start=True)
+        spec = {**BASE, "seed": 4, "simMs": 200, "chunkMs": 50}
+        job = sched.submit(spec)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not job.progress:
+            time.sleep(0.01)  # let at least one slice land
+        sched.drain()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not sched.quiescent():
+            time.sleep(0.02)
+        assert sched.quiescent(), "drain never went quiescent"
+        assert job.state is not JobState.FAILED, job.error
+        status = sched.drain_status()
+        assert status["draining"] and status["quiescent"]
+        sched.undrain()
+        assert job.done_event.wait(300), "job did not finish after undrain"
+        sched.stop()
+        assert job.state is JobState.DONE, job.error
+        assert job.result["digest"] == sched.run_singleton(spec)["digest"]

@@ -439,3 +439,111 @@ class TestRunMsGateway:
         status, _ = ws.dispatch("POST", "/w/network/runMs/10", "")
         assert status == 409
         assert ws.degraded is False
+
+
+class TestOpsEndpoints:
+    """ISSUE 14: the operational surface — /w/health, /w/ready, and the
+    graceful-drain admin endpoints, plus the quarantine status mapping
+    on the jobs surface."""
+
+    BASE = {"protocol": "PingPong", "params": {"node_ct": 32}, "simMs": 60}
+
+    def _ws(self, **kw):
+        from wittgenstein_tpu.serve import BatchScheduler
+
+        kw.setdefault("auto_start", False)
+        return WServer(scheduler=BatchScheduler(**kw))
+
+    def test_health_always_200_with_fleet_snapshot(self):
+        ws = self._ws()
+        status, h = ws.dispatch("GET", "/w/health", "")
+        assert status == 200
+        for key in ("queueDepth", "lanes", "lanesAlive", "draining",
+                    "quarantinedTotal", "laneRestartsTotal", "runCache",
+                    "compileStore", "errorKinds", "degraded"):
+            assert key in h, key
+        # health stays 200 while draining — liveness, not readiness
+        ws.jobs.drain()
+        status, h = ws.dispatch("GET", "/w/health", "")
+        assert status == 200
+        assert h["draining"] is True
+
+    def test_ready_flips_503_while_draining(self):
+        ws = self._ws()
+        status, r = ws.dispatch("GET", "/w/ready", "")
+        assert status == 200 and r["ready"] is True
+        ws.dispatch("POST", "/w/admin/drain", "")
+        status, r = ws.dispatch("GET", "/w/ready", "")
+        assert status == 503
+        assert r.payload["reason"] == "draining"
+        assert int(r.headers["Retry-After"]) >= 1
+        ws.dispatch("POST", "/w/admin/undrain", "")
+        status, r = ws.dispatch("GET", "/w/ready", "")
+        assert status == 200
+
+    def test_ready_503_when_degraded(self):
+        ws = self._ws()
+        ws.degraded = True
+        ws.degraded_reason = "test: slice blew up"
+        status, r = ws.dispatch("GET", "/w/ready", "")
+        assert status == 503
+        assert r.payload["reason"] == "degraded"
+
+    def test_drain_rejects_submissions_with_503(self):
+        ws = self._ws()
+        status, d = ws.dispatch("POST", "/w/admin/drain", "")
+        assert status == 200 and d["draining"] is True
+        status, r = ws.dispatch("POST", "/w/jobs", json.dumps(self.BASE))
+        assert status == 503
+        assert r.payload["draining"] is True
+        assert int(r.headers["Retry-After"]) >= 1
+        status, r = ws.dispatch(
+            "POST", "/w/sweep",
+            json.dumps({"protocol": "PingPong", "runs": 1}),
+        )
+        assert status == 503
+        status, d = ws.dispatch("GET", "/w/admin/drain", "")
+        assert status == 200 and d["quiescent"] is True
+        ws.dispatch("POST", "/w/admin/undrain", "")
+        status, r = ws.dispatch("POST", "/w/jobs", json.dumps(self.BASE))
+        assert status == 202
+
+    def test_quarantined_job_result_is_422_with_kind(self):
+        ws = self._ws(max_batch_replicas=4)
+        sched = ws.jobs
+        specs = [dict(self.BASE, seed=i) for i in range(3)]
+        ids = []
+        for s in specs:
+            status, r = ws.dispatch("POST", "/w/jobs", json.dumps(s))
+            assert status == 202
+            ids.append(r.payload["id"])
+        poison = ids[1]
+
+        def injector(fam, jobs):
+            if any(j.id == poison for j in jobs):
+                raise RuntimeError("chaos: poison row")
+
+        sched.chaos_injector = injector
+        while sched.drain_once():
+            pass
+        status, r = ws.dispatch("GET", f"/w/jobs/{poison}/result", "")
+        assert status == 422
+        assert r.payload["state"] == "quarantined"
+        assert r.payload["errorKind"] == "poison_row"
+        assert r.payload["quarantined"] is True
+        for jid in ids:
+            if jid == poison:
+                continue
+            status, r = ws.dispatch("GET", f"/w/jobs/{jid}/result", "")
+            assert status == 200, (jid, r)
+        # the status payload carries the taxonomy kind too
+        status, r = ws.dispatch("GET", f"/w/jobs/{poison}", "")
+        assert status == 200
+        assert r["errorKind"] == "poison_row"
+
+    def test_health_over_real_http(self, base_url):
+        status, h = get(base_url, "/w/health")
+        assert status == 200
+        assert h["lanesAlive"] >= 0
+        status, r = get(base_url, "/w/ready")
+        assert status == 200

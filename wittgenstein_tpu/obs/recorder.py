@@ -53,6 +53,52 @@ ENV_DIR = "WITT_OBS_DIR"
 
 DEFAULT_CAPACITY = 4096
 
+# The event vocabulary, for dashboards and assertions (record() does
+# NOT enforce membership — producers may add kinds, this tuple is the
+# documented catalog).  Grouped by producer:
+#   admission/dispatch (serve.scheduler): admission, admission-rejected,
+#     pack, batch-failed
+#   durable execution (runtime.supervisor): chunk, retry, watchdog,
+#     degrade, checkpoint, resume, kill, run-start, run-end
+#   fleet resilience (serve.scheduler, this PR's additions):
+#     lane-failed      a lane worker thread died (error_kind, streak)
+#     lane-restart     its supervised replacement thread started
+#     lane-abandoned   restart limit reached; lane left down
+#     family-rebound   sticky family→lane binding moved off a dead lane
+#     binding-expired  idle sticky binding reaped (binding_ttl_s)
+#     salvage-start    a failed packed batch enters bisection
+#     salvage-run      one bisection probe (rows, ok, error)
+#     quarantine       a poison row gets its terminal disposition
+#     salvage-done     bisection verdict (salvaged/quarantined/failed)
+#     drain-start      graceful drain engaged (admission now refuses)
+#     drain-end        undrain — admission + claiming resume
+KNOWN_KINDS = (
+    "admission",
+    "admission-rejected",
+    "pack",
+    "batch-failed",
+    "chunk",
+    "retry",
+    "watchdog",
+    "degrade",
+    "checkpoint",
+    "resume",
+    "kill",
+    "run-start",
+    "run-end",
+    "lane-failed",
+    "lane-restart",
+    "lane-abandoned",
+    "family-rebound",
+    "binding-expired",
+    "salvage-start",
+    "salvage-run",
+    "quarantine",
+    "salvage-done",
+    "drain-start",
+    "drain-end",
+)
+
 
 class FlightRecorder:
     """Thread-safe bounded event ring with optional tail-safe JSONL."""

@@ -5,9 +5,12 @@ See docs/durability.md for the operational model.
 """
 
 from .errors import (
+    RETRYABLE_KINDS,
     DeviceLostError,
     DurableRunError,
     FatalRunError,
+    LaneFailedError,
+    PoisonRowError,
     PreemptedError,
     ResumeMismatchError,
     RetriesExhaustedError,
@@ -15,6 +18,8 @@ from .errors import (
     TransientRunError,
     WatchdogTimeoutError,
     classify,
+    reset_taxonomy_counters,
+    taxonomy_counters,
 )
 from .compile_store import (
     CompileStore,
@@ -24,7 +29,13 @@ from .compile_store import (
     get_compile_store,
     set_compile_store,
 )
-from .policy import DegradePolicy, RetryPolicy, WatchdogPolicy, WatchdogWorker
+from .policy import (
+    DegradePolicy,
+    RetryPolicy,
+    SalvagePolicy,
+    WatchdogPolicy,
+    WatchdogWorker,
+)
 from .supervisor import (
     RunReport,
     Supervisor,
@@ -44,12 +55,16 @@ __all__ = [
     "DeviceLostError",
     "DurableRunError",
     "FatalRunError",
+    "LaneFailedError",
+    "PoisonRowError",
     "PreemptedError",
+    "RETRYABLE_KINDS",
     "ResumeMismatchError",
     "RetriesExhaustedError",
     "RunIncompleteError",
     "RunReport",
     "RetryPolicy",
+    "SalvagePolicy",
     "Supervisor",
     "TransientRunError",
     "WatchdogPolicy",
@@ -57,6 +72,8 @@ __all__ = [
     "WatchdogWorker",
     "chunk_time_histogram",
     "classify",
+    "reset_taxonomy_counters",
     "run_with_deadline",
     "stable_run_key",
+    "taxonomy_counters",
 ]

@@ -18,6 +18,9 @@ r3-r5 TPU-tunnel postmortems.
 
 from __future__ import annotations
 
+import threading
+from collections import Counter
+
 
 class DurableRunError(Exception):
     """Base for every structured supervisor failure."""
@@ -73,6 +76,35 @@ class ResumeMismatchError(FatalRunError):
     chunk geometry mismatch) — resuming would silently mix runs."""
 
 
+class PoisonRowError(FatalRunError):
+    """One row of a packed batch is semantically poisonous: the batch
+    failed WITH it and succeeded WITHOUT it (scheduler salvage
+    bisection), or its row could not even be built.  Quarantining the
+    carrying job is the only fix — retrying the batch replays the same
+    poison.  Carries the job id and the original failure so the job's
+    terminal status stays honest."""
+
+    def __init__(self, job_id: str, cause: BaseException):
+        self.job_id = job_id
+        self.cause = cause
+        super().__init__(
+            f"job {job_id} poisons its batch: "
+            f"{type(cause).__name__}: {cause}"
+        )
+
+
+class LaneFailedError(TransientRunError):
+    """A dispatch lane's worker thread died (escaped exception or an
+    injected chaos kill).  Transient at fleet level: the scheduler
+    restarts the lane and re-binds its sticky families to a healthy
+    one; no job is lost (undispatched work stays queued, parked batches
+    keep their checkpoints)."""
+
+    def __init__(self, lane: int, reason: str = "lane worker died"):
+        self.lane = lane
+        super().__init__(f"lane {lane}: {reason}")
+
+
 class RunIncompleteError(DurableRunError):
     """A controlled partial stop (budget exhausted / chunk cap reached).
     Carries the partial RunReport so callers can checkpoint-and-requeue."""
@@ -112,12 +144,30 @@ _DEVICE_LOST_MARKERS = (
 )
 
 
-def classify(exc: BaseException) -> str:
-    """Map an exception to 'transient' | 'device_lost' | 'fatal'.
+# process-wide taxonomy counters: every classify() call increments its
+# kind, so /w/health and the chaos harness can report how failures
+# distributed without re-walking the flight recorder
+_TAXONOMY_LOCK = threading.Lock()
+_TAXONOMY_COUNTS: Counter = Counter()
 
-    device_lost is a sub-case of transient that additionally makes the
-    current backend suspect — the degradation policy keys off it.
-    """
+
+def taxonomy_counters() -> dict:
+    """Snapshot of {kind: count} over every classify() call since
+    process start (or the last reset)."""
+    with _TAXONOMY_LOCK:
+        return dict(_TAXONOMY_COUNTS)
+
+
+def reset_taxonomy_counters() -> None:
+    with _TAXONOMY_LOCK:
+        _TAXONOMY_COUNTS.clear()
+
+
+def _classify(exc: BaseException) -> str:
+    if isinstance(exc, PoisonRowError):
+        return "poison_row"
+    if isinstance(exc, LaneFailedError):
+        return "lane_failed"
     if isinstance(exc, DeviceLostError):
         return "device_lost"
     if isinstance(exc, TransientRunError):
@@ -132,3 +182,26 @@ def classify(exc: BaseException) -> str:
     if any(m in text for m in _TRANSIENT_MARKERS):
         return "transient"
     return "fatal"
+
+
+#: kinds the supervisor may retry; everything else ('fatal',
+#: 'poison_row', future additions) must propagate — replaying a
+#: semantic failure reproduces it.  lane_failed IS retryable: a lane
+#: death says nothing about the work it carried (the fleet restarts
+#: the lane and the jobs re-run elsewhere, bitwise-identical).
+RETRYABLE_KINDS = frozenset({"transient", "device_lost", "lane_failed"})
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception to a taxonomy kind: 'transient' | 'device_lost'
+    | 'fatal' | 'poison_row' | 'lane_failed'.
+
+    device_lost is a sub-case of transient that additionally makes the
+    current backend suspect — the degradation policy keys off it.
+    poison_row / lane_failed are fleet-level kinds (serve scheduler);
+    only RETRYABLE_KINDS are safe to replay.
+    """
+    kind = _classify(exc)
+    with _TAXONOMY_LOCK:
+        _TAXONOMY_COUNTS[kind] += 1
+    return kind

@@ -149,6 +149,26 @@ class WatchdogWorker:
 
 
 @dataclass(frozen=True)
+class SalvagePolicy:
+    """How the serve scheduler responds to a failed packed batch.
+
+    With ``enabled`` the scheduler bisects the live rows: a failing
+    subset splits in half, a passing subset's results are KEPT (padding
+    to the fixed capacity means every subset re-run is the same
+    compiled program, and replica rows are lane-independent under vmap
+    — a surviving row's bytes equal its singleton run's).  Rows that
+    fail alone are quarantined as PoisonRowError; with one poison among
+    k rows identification costs ~log2(k) re-runs.  ``max_probe_runs``
+    bounds the salvage work per batch — past it, still-unresolved rows
+    fail with the original batch error (honest FAILED, not a guessed
+    quarantine).  Disabled, a batch failure fails every live row (the
+    pre-resilience blast-radius behavior)."""
+
+    enabled: bool = True
+    max_probe_runs: int = 16
+
+
+@dataclass(frozen=True)
 class DegradePolicy:
     """What to do when the device is lost: with cpu_fallback, the
     supervisor re-places the last anchor on CPU and continues there,

@@ -59,6 +59,7 @@ import numpy as np
 from ..engine.checkpoint import CheckpointManager
 from ..obs import FlightRecorder, TraceContext, failure_dump_paths, get_recorder, mint_context
 from .errors import (
+    RETRYABLE_KINDS,
     DurableRunError,
     FatalRunError,
     ResumeMismatchError,
@@ -178,6 +179,7 @@ class Supervisor:
         heartbeat: Optional[Callable[[int, float], None]] = None,
         budget_s: float = float("inf"),
         max_chunks_this_run: Optional[int] = None,
+        should_stop: Optional[Callable[[], bool]] = None,
         sleep: Callable[[float], None] = time.sleep,
         consume_template: bool = False,
         tracer: Any = None,
@@ -210,6 +212,10 @@ class Supervisor:
         self.heartbeat = heartbeat
         self.budget_s = budget_s
         self.max_chunks_this_run = max_chunks_this_run
+        # cooperative preemption (serve drain): checked between chunks;
+        # True -> checkpoint now and return a controlled partial stop,
+        # exactly like a budget/cap stop — resume replays bit-identical
+        self.should_stop = should_stop
         self.sleep = sleep
         self.consume_template = consume_template
         # optional telemetry.trace.SpanTracer: chunk spans + instants
@@ -462,7 +468,10 @@ class Supervisor:
                     self.max_chunks_this_run is not None
                     and len(times) >= self.max_chunks_this_run
                 )
-                if over_budget or over_cap:
+                stop_requested = (
+                    self.should_stop is not None and self.should_stop()
+                )
+                if over_budget or over_cap or stop_requested:
                     # controlled partial stop: checkpoint NOW (even
                     # off-cadence — resumability beats cadence) and report
                     if self.manager is not None and i > anchor_chunk:
@@ -470,7 +479,11 @@ class Supervisor:
                         checkpoints += 1
                     self._record(
                         "partial-stop", chunk=i,
-                        reason="budget" if over_budget else "chunk-cap",
+                        reason=(
+                            "budget" if over_budget
+                            else "chunk-cap" if over_cap
+                            else "stop-requested"
+                        ),
                         chunks_done=i,
                     )
                     return RunReport(
@@ -504,7 +517,9 @@ class Supervisor:
                             "chunk-failed", chunk=i, kind=kind,
                             error=type(e).__name__,
                         )
-                    if kind == "fatal":
+                    if kind not in RETRYABLE_KINDS:
+                        # fatal, poison_row, lane_failed, any future
+                        # non-environmental kind: replaying reproduces it
                         raise
                     fail_streak += 1
                     retries_total += 1

@@ -6,6 +6,7 @@ discipline, batching/preemption semantics, and the SLO metric catalog.
 """
 
 from .jobs import (
+    DrainingError,
     Job,
     JobQueue,
     JobSpec,
@@ -22,6 +23,7 @@ from .scheduler import BatchScheduler, ScenarioFamily, state_digest
 
 __all__ = [
     "BatchScheduler",
+    "DrainingError",
     "Job",
     "JobQueue",
     "JobSpec",

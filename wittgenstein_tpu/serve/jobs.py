@@ -12,7 +12,9 @@ grouping, not time-slicing.  This module owns the host-side half:
     JSON op list by ``plan_from_spec``), sim horizon, execution mode
     (direct vs chunked/preemptible) and priority;
   * ``Job``: the queued unit with a typed lifecycle
-    (QUEUED -> RUNNING -> DONE | FAILED | CANCELLED), timestamps for
+    (QUEUED -> RUNNING -> DONE | FAILED | CANCELLED | QUARANTINED,
+    the last being the 4xx-style verdict of batch salvage — the spec
+    itself is the fault), timestamps for
     the SLO quantiles, a threading.Event for blocking waiters, and a
     cancel flag honored at batch boundaries;
   * ``JobQueue``: a bounded registry + pending list.  Admission control
@@ -46,10 +48,20 @@ class JobState(str, enum.Enum):
     DONE = "done"
     FAILED = "failed"
     CANCELLED = "cancelled"
+    # terminal 4xx-style status: batch salvage proved this job's row is
+    # what failed the batch (the batch succeeds without it), so the
+    # fault is the SPEC's, not the fleet's — resubmitting unchanged
+    # reproduces it.  Distinct from FAILED (a 5xx: the fleet broke).
+    QUARANTINED = "quarantined"
 
 
 #: terminal states: the job's Event is set and its record is immutable
-TERMINAL = frozenset({JobState.DONE, JobState.FAILED, JobState.CANCELLED})
+TERMINAL = frozenset({
+    JobState.DONE,
+    JobState.FAILED,
+    JobState.CANCELLED,
+    JobState.QUARANTINED,
+})
 
 
 class QueueFullError(Exception):
@@ -61,6 +73,17 @@ class QueueFullError(Exception):
             f"job queue full ({depth} pending); retry in ~{retry_after_s}s"
         )
         self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+class DrainingError(Exception):
+    """Admission refused: the scheduler is in graceful drain (admin
+    surface).  Carries the Retry-After hint for the HTTP 503."""
+
+    def __init__(self, retry_after_s: int):
+        super().__init__(
+            f"scheduler is draining; retry in ~{retry_after_s}s"
+        )
         self.retry_after_s = retry_after_s
 
 
@@ -294,6 +317,11 @@ class Job:
     progress: List[dict] = dataclasses.field(default_factory=list)
     result: Optional[dict] = None
     error: Optional[str] = None
+    # runtime.errors.classify kind of the failure ("poison_row",
+    # "lane_failed", "fatal", ...) — the honest-status field /w/jobs
+    # payloads surface so clients can tell "your spec is poison" (4xx)
+    # from "the fleet broke" (5xx)
+    error_kind: Optional[str] = None
     exc: Optional[BaseException] = None
     cancel_requested: bool = False
     batch_id: Optional[str] = None
@@ -317,10 +345,12 @@ class Job:
 
             self.run_id = new_run_id("job")
 
-    def finish(self, state: JobState, *, result=None, error=None, exc=None):
+    def finish(self, state: JobState, *, result=None, error=None,
+               error_kind=None, exc=None):
         self.state = state
         self.result = result
         self.error = error
+        self.error_kind = error_kind
         self.exc = exc
         self.finished_at = time.monotonic()
         if self.first_result_at is None and state is JobState.DONE:
@@ -351,6 +381,8 @@ class Job:
             out["attribution"] = self.attribution
         if self.error:
             out["error"] = self.error
+        if self.error_kind:
+            out["errorKind"] = self.error_kind
         return out
 
 

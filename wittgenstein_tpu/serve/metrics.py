@@ -57,6 +57,7 @@ class ServeMetrics:
         self.jobs_completed = 0
         self.jobs_failed = 0
         self.jobs_cancelled = 0
+        self.jobs_quarantined = 0
         self.batches_total = 0
         self.replicas_packed_total = 0
         self.replicas_capacity_total = 0
@@ -69,6 +70,15 @@ class ServeMetrics:
         self.wave_width_max = 0
         # lane index -> dispatch count (busy seconds live on the lanes)
         self._lane_dispatches: dict = {}
+        # fleet resilience: lane supervision + batch salvage + drain
+        self.lane_failures_total = 0
+        self.lane_restarts_total = 0
+        self.lane_rebinds_total = 0
+        self.bindings_expired_total = 0
+        self.salvage_batches_total = 0
+        self.salvage_runs_total = 0
+        self.salvage_seconds_total = 0.0
+        self.drains_total = 0
         self._latency_s = deque(maxlen=self.WINDOW)
         self._ttfr_s = deque(maxlen=self.WINDOW)
         # (run_id, tenant, latency_s) of recently completed jobs
@@ -92,6 +102,8 @@ class ServeMetrics:
                 self.jobs_failed += 1
             elif job.state is JobState.CANCELLED:
                 self.jobs_cancelled += 1
+            elif job.state is JobState.QUARANTINED:
+                self.jobs_quarantined += 1
             if job.finished_at and job.submitted_at:
                 lat = job.finished_at - job.submitted_at
                 self._latency_s.append(lat)
@@ -170,6 +182,38 @@ class ServeMetrics:
         with self._lock:
             self.resumes_total += 1
 
+    # -- fleet resilience ----------------------------------------------
+
+    def observe_lane_failure(self) -> None:
+        with self._lock:
+            self.lane_failures_total += 1
+
+    def observe_lane_restart(self) -> None:
+        with self._lock:
+            self.lane_restarts_total += 1
+
+    def observe_rebind(self, n: int = 1) -> None:
+        """``n`` sticky family bindings moved off a failed lane."""
+        with self._lock:
+            self.lane_rebinds_total += n
+
+    def observe_binding_expired(self, n: int = 1) -> None:
+        with self._lock:
+            self.bindings_expired_total += n
+
+    def observe_salvage(self, runs: int, seconds: float) -> None:
+        """One batch salvage completed: ``runs`` probe/re-run dispatches
+        costing ``seconds`` of wall time (the salvage overhead
+        BENCH_SERVE tracks)."""
+        with self._lock:
+            self.salvage_batches_total += 1
+            self.salvage_runs_total += runs
+            self.salvage_seconds_total += seconds
+
+    def observe_drain(self) -> None:
+        with self._lock:
+            self.drains_total += 1
+
     # -- export --------------------------------------------------------
 
     def latency_quantiles(self) -> dict:
@@ -192,6 +236,7 @@ class ServeMetrics:
                 "jobs_completed": self.jobs_completed,
                 "jobs_failed": self.jobs_failed,
                 "jobs_cancelled": self.jobs_cancelled,
+                "jobs_quarantined": self.jobs_quarantined,
                 "batches_total": self.batches_total,
                 "replicas_packed_total": self.replicas_packed_total,
                 "replicas_capacity_total": self.replicas_capacity_total,
@@ -207,6 +252,16 @@ class ServeMetrics:
                 "wave_width_last": self.wave_width_last,
                 "wave_width_max": self.wave_width_max,
                 "lane_dispatches": dict(self._lane_dispatches),
+                "lane_failures_total": self.lane_failures_total,
+                "lane_restarts_total": self.lane_restarts_total,
+                "lane_rebinds_total": self.lane_rebinds_total,
+                "bindings_expired_total": self.bindings_expired_total,
+                "salvage_batches_total": self.salvage_batches_total,
+                "salvage_runs_total": self.salvage_runs_total,
+                "salvage_seconds_total": round(
+                    self.salvage_seconds_total, 4
+                ),
+                "drains_total": self.drains_total,
             }
         if queue_depth is not None:
             out["queue_depth"] = queue_depth
@@ -231,6 +286,7 @@ class ServeMetrics:
                 ("completed", self.jobs_completed),
                 ("failed", self.jobs_failed),
                 ("cancelled", self.jobs_cancelled),
+                ("quarantined", self.jobs_quarantined),
             ):
                 p.add("serve_jobs_total", n, "job lifecycle counters",
                       "counter", {"state": state})
@@ -257,6 +313,30 @@ class ServeMetrics:
                   "busy dispatch lanes when the last batch started")
             p.add("serve_wave_width_max", self.wave_width_max,
                   "peak concurrent dispatch lanes observed")
+            p.add("serve_lane_failures_total", self.lane_failures_total,
+                  "lane worker threads that died (exception or injected "
+                  "kill)", "counter")
+            p.add("serve_lane_restarts_total", self.lane_restarts_total,
+                  "lane workers restarted by fleet supervision", "counter")
+            p.add("serve_lane_rebinds_total", self.lane_rebinds_total,
+                  "sticky family bindings moved off a failed lane",
+                  "counter")
+            p.add("serve_bindings_expired_total",
+                  self.bindings_expired_total,
+                  "idle sticky family->lane bindings reclaimed", "counter")
+            p.add("serve_quarantined_total", self.jobs_quarantined,
+                  "jobs quarantined as poison rows by batch salvage",
+                  "counter")
+            p.add("serve_salvage_batches_total", self.salvage_batches_total,
+                  "failed batches put through salvage bisection", "counter")
+            p.add("serve_salvage_runs_total", self.salvage_runs_total,
+                  "probe/re-run dispatches issued by salvage", "counter")
+            p.add("serve_salvage_seconds_total",
+                  round(self.salvage_seconds_total, 4),
+                  "wall seconds spent salvaging failed batches", "counter")
+            p.add("serve_drains_total", self.drains_total,
+                  "graceful drains entered via the admin surface",
+                  "counter")
             for lane, n in sorted(self._lane_dispatches.items()):
                 p.add("serve_lane_dispatches_total", n,
                       "dispatches issued per lane", "counter",

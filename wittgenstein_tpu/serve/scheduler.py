@@ -73,13 +73,14 @@ mode costs one extra compile per family, not one per slice.
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import os
 import shutil
 import tempfile
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..obs import (
     FlightRecorder,
@@ -88,7 +89,15 @@ from ..obs import (
     get_recorder,
     mint_context,
 )
+from ..runtime.errors import (
+    RETRYABLE_KINDS,
+    LaneFailedError,
+    PoisonRowError,
+    classify,
+)
+from ..runtime.policy import SalvagePolicy
 from .jobs import (
+    DrainingError,
     Job,
     JobQueue,
     JobSpec,
@@ -179,6 +188,16 @@ class _Lane:
         self.busy = False
         self.dispatches = 0
         self.busy_seconds = 0.0
+        # supervision state: a lane thread that dies (exception or
+        # injected kill) is restarted by _on_lane_failure; fail_streak
+        # paces the restart backoff and resets on the next clean claim
+        self.restarts = 0
+        self.fail_streak = 0
+        self.kill_requested = False
+        self.abandoned = False
+
+    def alive(self) -> bool:
+        return self.thread is not None and self.thread.is_alive()
 
     def describe(self) -> dict:
         return {
@@ -191,6 +210,8 @@ class _Lane:
             "busy": self.busy,
             "dispatches": self.dispatches,
             "busySeconds": round(self.busy_seconds, 4),
+            "alive": self.alive(),
+            "restarts": self.restarts,
         }
 
 
@@ -244,6 +265,9 @@ class BatchScheduler:
         recorder: Optional[FlightRecorder] = None,
         device_groups: int = 1,
         horizon_quantum_ms: int = 0,
+        binding_ttl_s: float = 300.0,
+        salvage: Optional[SalvagePolicy] = None,
+        lane_restart_limit: int = 0,
     ):
         if max_batch_replicas < 1:
             raise ValueError(
@@ -299,6 +323,32 @@ class BatchScheduler:
         self._active_dispatches = 0
         self._worker_lock = threading.Lock()
         self._stop = threading.Event()
+        # -- fleet resilience ------------------------------------------
+        # sticky bindings expire once a family has had no queued work
+        # and no parked batch for this long (<= 0: expire immediately
+        # when idle): the family's compiled programs stay in the run
+        # cache either way — expiry only re-decides the LANE, so a dead
+        # family stops pinning lane choice forever (the PR-13 leak).  A
+        # re-bind to a different lane costs one re-place compile only
+        # when device_groups > 1.
+        self.binding_ttl_s = binding_ttl_s
+        self._binding_used: Dict[str, float] = {}
+        # batch salvage: a failed packed batch is bisected to isolate
+        # the poison row instead of failing every rider (runtime.policy)
+        self.salvage = salvage if salvage is not None else SalvagePolicy()
+        # 0 = restart crashed lanes forever; > 0 = abandon after N
+        self.lane_restart_limit = lane_restart_limit
+        # graceful drain: admission refuses, lanes stop claiming,
+        # in-flight chunked slices checkpoint-stop (Supervisor
+        # should_stop); pending + parked work survives for undrain
+        self._draining = threading.Event()
+        # chaos hook (tests, scripts/chaos_smoke.py): called with
+        # (family, jobs) immediately before EVERY device run — batch
+        # dispatches and salvage probes alike — so an injected poison
+        # fails exactly the subsets containing it and bisection can
+        # isolate it.  run_singleton never calls it: reference results
+        # stay fault-free.
+        self.chaos_injector: Optional[Callable] = None
 
     # -- admission -----------------------------------------------------
 
@@ -379,7 +429,10 @@ class BatchScheduler:
         """Parse, validate, and enqueue one job (raises ValueError /
         KeyError on a malformed spec, QueueFullError on backpressure).
         This is where the job's run_id is minted (Job.__post_init__) —
-        the first flight-recorder event of the run is its admission."""
+        the first flight-recorder event of the run is its admission.
+        While draining, admission refuses with DrainingError (the HTTP
+        layer maps it to 503 + Retry-After)."""
+        self._check_admission()
         spec = JobSpec.from_dict(spec_dict)
         job = Job(spec=spec, compat=self.pre_key(spec),
                   priority=spec.priority)
@@ -411,6 +464,7 @@ class BatchScheduler:
         """Queue an opaque host-side thunk (the rerouted /w/sweep and
         the legacy runMs gateway): it occupies one lane turn and is
         never packed with batch jobs."""
+        self._check_admission()
         job = Job(spec=None, compat="", kind="legacy", thunk=thunk,
                   priority=priority)
         job.compat = f"legacy-{job.id}"
@@ -617,7 +671,10 @@ class BatchScheduler:
         lock: resume this lane's best parked batch or pop the best
         claimable pending group (binding its family to the lane).
         Returns ("parked", batch) | ("legacy", job) | ("jobs", jobs) |
-        None."""
+        None.  While draining nothing is claimable: pending jobs stay
+        queued and parked batches stay checkpoint-parked."""
+        if self._draining.is_set():
+            return None
         with self._dispatch_lock:
             parked = max(
                 (
@@ -633,6 +690,7 @@ class BatchScheduler:
                 best is None or best.priority <= parked.priority
             ):
                 parked.running = True
+                self._binding_used[parked.family.key] = time.monotonic()
                 self._mark_busy(lane)
                 return ("parked", parked)
             if best is None:
@@ -654,6 +712,7 @@ class BatchScheduler:
             # compiled program's signature, so a family that wandered
             # across lanes would compile once per lane
             self._family_lane.setdefault(best.compat, lane.index)
+            self._binding_used[best.compat] = time.monotonic()
             self._mark_busy(lane)
             return ("jobs", jobs)
 
@@ -732,12 +791,38 @@ class BatchScheduler:
             )
         try:
             fam = self.family_for(live[0].spec)
-            stacked = self._pack(fam, live)
-        except BaseException as e:  # noqa: BLE001 — build/pack failure
+        except BaseException as e:  # noqa: BLE001 — family build failure
+            # the family comes from the shared pre-key, so no single
+            # job can be blamed: the whole group fails honestly
             for j in live:
                 self._finish_job(
                     j, JobState.FAILED,
-                    error=f"{type(e).__name__}: {e}", exc=e,
+                    error=f"{type(e).__name__}: {e}",
+                    error_kind=classify(e), exc=e,
+                )
+            return
+        # per-row blast-radius control: a job whose OWN row fails to
+        # build (bad state-only params, SL801 violation) is quarantined
+        # alone instead of failing every rider in the batch
+        ok = []
+        for j in live:
+            try:
+                self._row(fam, j.spec)
+            except BaseException as e:  # noqa: BLE001 — poison row build
+                self._quarantine(j, e, phase="row-build")
+            else:
+                ok.append(j)
+        live = ok
+        if not live:
+            return
+        try:
+            stacked = self._pack(fam, live)
+        except BaseException as e:  # noqa: BLE001 — pack failure
+            for j in live:
+                self._finish_job(
+                    j, JobState.FAILED,
+                    error=f"{type(e).__name__}: {e}",
+                    error_kind=classify(e), exc=e,
                 )
             return
         with self._dispatch_lock:
@@ -794,6 +879,7 @@ class BatchScheduler:
             stacked = lane.group.place(stacked)
         t0 = time.monotonic()
         try:
+            self._chaos_check(fam, jobs)
             out, _stats = sharded_run_stats(fam.net, stacked, fam.sim_ms)
             self._finalize(fam, jobs, out)
         except BaseException as e:  # noqa: BLE001 — device failure
@@ -801,11 +887,10 @@ class BatchScheduler:
                 "batch-failed", ctx=ctx, batch_id=batch_id,
                 error=f"{type(e).__name__}: {e}"[:500],
             )
-            for j in jobs:
-                self._finish_job(
-                    j, JobState.FAILED,
-                    error=f"{type(e).__name__}: {e}", exc=e,
-                )
+            self._salvage_batch(
+                fam, jobs, self._direct_salvage_runner(fam, lane),
+                batch_id, ctx, e,
+            )
             return
         finally:
             dt = time.monotonic() - t0
@@ -851,6 +936,9 @@ class BatchScheduler:
             ctx=ctx,
             recorder=self.recorder,
             placement=placement,
+            # graceful drain: an in-flight slice stops at its next
+            # chunk boundary (checkpoint on disk), batch stays parked
+            should_stop=self._draining.is_set,
             run_meta={
                 "batch_id": batch_id,
                 "members": [
@@ -894,6 +982,7 @@ class BatchScheduler:
             )
             t0 = time.monotonic()
             try:
+                self._chaos_check(parked.family, parked.jobs)
                 report = parked.supervisor.run()
             except BaseException as e:  # noqa: BLE001 — supervised failure
                 # the supervisor already recorded + dumped its black
@@ -903,13 +992,16 @@ class BatchScheduler:
                     batch_id=parked.batch_id,
                     error=f"{type(e).__name__}: {e}"[:500],
                 )
-                for j in parked.jobs:
-                    if j.id not in parked.finished:
-                        self._finish_job(
-                            j, JobState.FAILED,
-                            error=f"{type(e).__name__}: {e}", exc=e,
-                        )
+                survivors = [
+                    j for j in parked.jobs if j.id not in parked.finished
+                ]
+                lane = self._lanes[parked.lane]
                 self._drop_parked(parked)
+                self._salvage_batch(
+                    parked.family, survivors,
+                    self._chunked_salvage_runner(parked.family, lane),
+                    parked.batch_id, parked.supervisor.ctx, e,
+                )
                 return True
             dt = time.monotonic() - t0
             self._note_batch_time(parked.family.key, dt)
@@ -1063,6 +1155,191 @@ class BatchScheduler:
                 )
             self._finish_job(job, JobState.DONE, result=result)
 
+    # -- poison quarantine + batch salvage ------------------------------
+
+    def _chaos_check(self, fam: ScenarioFamily, jobs: List[Job]) -> None:
+        if self.chaos_injector is not None:
+            self.chaos_injector(fam, jobs)
+
+    def _quarantine(self, job: Job, cause: BaseException,
+                    phase: str = "salvage") -> None:
+        """Terminal 4xx-style disposition: this job's OWN row breaks the
+        batch, so it must never be packed (or retried) again."""
+        perr = PoisonRowError(job.id, cause)
+        kind = classify(perr)
+        self.recorder.record(
+            "quarantine", ctx=_job_ctx(job), job_id=job.id,
+            batch_id=job.batch_id, phase=phase,
+            error=str(perr)[:300],
+        )
+        self._finish_job(
+            job, JobState.QUARANTINED,
+            error=str(perr), error_kind=kind, exc=perr,
+        )
+
+    def _direct_salvage_runner(self, fam: ScenarioFamily, lane=None):
+        """Re-run a subset of a failed direct batch.  Padding to the
+        SAME replica capacity keeps the leaf signature identical, so a
+        probe is a run-cache hit on the family's one compiled program;
+        vmap row-independence makes each survivor's result bitwise
+        identical to its singleton."""
+        from ..parallel.replica_shard import sharded_run_stats
+
+        def run(subset: List[Job]) -> None:
+            stacked = self._pack(fam, subset)
+            if lane is not None and lane.group is not None:
+                stacked = lane.group.place(stacked)
+            self._chaos_check(fam, subset)
+            out, _stats = sharded_run_stats(fam.net, stacked, fam.sim_ms)
+            self._finalize(fam, subset, out)
+
+        return run
+
+    def _chunked_salvage_runner(self, fam: ScenarioFamily, lane=None):
+        """Re-run a subset of a failed chunked batch from chunk 0,
+        replaying the shared unit schedule (jobs.chunk_schedule) and
+        capturing each row at its own horizon boundary — the identical
+        schedule the singleton replays, so survivors stay bitwise."""
+        import jax
+
+        from ..parallel.replica_shard import _run_and_reduce
+
+        unit = fam.unit_ms
+
+        def run(subset: List[Job]) -> None:
+            job_chunks = [
+                max(1, j.spec.sim_ms // unit) for j in subset
+            ]
+            job_rems = [
+                j.spec.sim_ms % unit if j.spec.sim_ms > unit else 0
+                for j in subset
+            ]
+            stacked = self._pack(fam, subset)
+            if lane is not None and lane.group is not None:
+                stacked = lane.group.place(stacked)
+            self._chaos_check(fam, subset)
+            cached = _run_and_reduce(fam.net, unit)
+            rows = {}
+            for step in range(1, max(job_chunks) + 1):
+                stacked = cached(stacked)[0]
+                for i, j in enumerate(subset):
+                    if job_chunks[i] != step:
+                        continue
+                    rem = job_rems[i]
+                    rows[j.id] = (
+                        self._run_remainder(fam, stacked, i, rem)
+                        if rem
+                        else jax.tree_util.tree_map(
+                            lambda a, i=i: a[i], stacked
+                        )
+                    )
+            attrib = self._attribution(fam, subset, stacked)
+            for j in subset:
+                if j.cancel_requested:
+                    self._finish_job(j, JobState.CANCELLED)
+                    continue
+                result = self._row_result(fam, rows[j.id])
+                j.progress = result["progress"]
+                if attrib is not None:
+                    j.attribution = self._job_attribution(attrib, j)
+                    result["attribution"] = j.attribution
+                    self.metrics.observe_tenant(
+                        j.spec.tenant, attrib["jobs"].get(j.id)
+                    )
+                self._finish_job(j, JobState.DONE, result=result)
+
+        return run
+
+    def _salvage_batch(self, fam: ScenarioFamily, jobs: List[Job],
+                       runner, batch_id, ctx,
+                       error: BaseException) -> None:
+        """Bisect a failed batch to isolate the poison row(s).
+
+        A passing probe's results are KEPT (same compiled program, rows
+        lane-independent under vmap → bitwise identical to singletons);
+        a failing probe splits in half; a failing singleton probe is the
+        poison and is QUARANTINED — unless its failure classifies as
+        retryable (transient/device_lost), where blaming the job would
+        be dishonest, so it FAILS with the taxonomy kind instead.  When
+        the probe budget (SalvagePolicy.max_probe_runs) runs out,
+        unresolved rows FAIL with the original batch error rather than
+        guess."""
+        err_s = f"{type(error).__name__}: {error}"
+        if not self.salvage.enabled:
+            for j in jobs:
+                self._finish_job(
+                    j, JobState.FAILED, error=err_s,
+                    error_kind=classify(error), exc=error,
+                )
+            return
+        t0 = time.monotonic()
+        self.recorder.record(
+            "salvage-start", ctx=ctx, batch_id=batch_id,
+            rows=len(jobs), error=err_s[:300],
+        )
+        runs = 0
+        quarantined: List[tuple] = []
+        failed: List[tuple] = []
+
+        def probe(subset: List[Job]) -> None:
+            nonlocal runs
+            if runs >= self.salvage.max_probe_runs:
+                failed.extend((j, error) for j in subset)
+                return
+            runs += 1
+            try:
+                runner(subset)  # finalizes DONE/CANCELLED on success
+            except BaseException as e:  # noqa: BLE001 — probe failure
+                self.recorder.record(
+                    "salvage-run", ctx=ctx, batch_id=batch_id,
+                    rows=len(subset), ok=False,
+                    error=f"{type(e).__name__}: {e}"[:300],
+                )
+                if len(subset) == 1:
+                    if classify(e) in RETRYABLE_KINDS:
+                        failed.append((subset[0], e))
+                    else:
+                        quarantined.append((subset[0], e))
+                    return
+                mid = len(subset) // 2
+                probe(subset[:mid])
+                probe(subset[mid:])
+            else:
+                self.recorder.record(
+                    "salvage-run", ctx=ctx, batch_id=batch_id,
+                    rows=len(subset), ok=True,
+                )
+
+        if len(jobs) == 1:
+            # singleton batch: one probe doubles as the transient retry
+            probe(jobs)
+        else:
+            mid = len(jobs) // 2
+            probe(jobs[:mid])
+            probe(jobs[mid:])
+        for j, cause in quarantined:
+            if j.cancel_requested:
+                self._finish_job(j, JobState.CANCELLED)
+            else:
+                self._quarantine(j, cause)
+        for j, cause in failed:
+            if j.cancel_requested:
+                self._finish_job(j, JobState.CANCELLED)
+                continue
+            self._finish_job(
+                j, JobState.FAILED,
+                error=f"{type(cause).__name__}: {cause}",
+                error_kind=classify(cause), exc=cause,
+            )
+        dt = time.monotonic() - t0
+        self.metrics.observe_salvage(runs, dt)
+        self.recorder.record(
+            "salvage-done", ctx=ctx, batch_id=batch_id, runs=runs,
+            seconds=round(dt, 4), quarantined=len(quarantined),
+            failed=len(failed),
+            salvaged=len(jobs) - len(quarantined) - len(failed),
+        )
+
     # -- workers --------------------------------------------------------
 
     def start(self) -> None:
@@ -1075,6 +1352,9 @@ class BatchScheduler:
         with self._worker_lock:
             self._stop.clear()
             for lane in self._lanes:
+                # explicit (re)start is an operator action: it pardons
+                # lanes abandoned at the restart limit
+                lane.abandoned = False
                 if lane.thread is not None and lane.thread.is_alive():
                     continue
                 lane.thread = threading.Thread(
@@ -1098,15 +1378,145 @@ class BatchScheduler:
             t.join(timeout)
 
     def _loop(self, lane_idx: int) -> None:
-        while not self._stop.is_set():
-            try:
-                if not self.drain_once(lane_idx):
+        lane = self._lanes[lane_idx]
+        try:
+            while not self._stop.is_set():
+                if lane.kill_requested:
+                    lane.kill_requested = False
+                    raise LaneFailedError(lane_idx, "injected kill")
+                if self.drain_once(lane_idx):
+                    lane.fail_streak = 0
+                else:
+                    self._reap_bindings()
                     self.queue.wait_for_work(timeout=0.2)
-            except Exception:  # noqa: BLE001 — worker must not die
-                # per-job failures are reported on the jobs themselves;
-                # anything reaching here is a scheduler bug — park for a
-                # beat instead of spinning
-                time.sleep(0.1)
+        except BaseException as e:  # noqa: BLE001 — lane death
+            if self._stop.is_set():
+                return
+            # per-job failures are reported on the jobs themselves; an
+            # exception REACHING here killed the worker thread — treat
+            # it as a lane failure: supervise, re-bind, restart
+            self._on_lane_failure(lane, e)
+
+    def inject_lane_failure(self, lane: int = 0) -> None:
+        """Chaos hook: make the lane's worker raise LaneFailedError at
+        its next loop iteration, exercising the REAL death → supervise →
+        re-bind → restart path (a Python thread cannot be killed from
+        outside, so the kill is cooperative but the recovery is not)."""
+        self._lanes[lane].kill_requested = True
+        self.queue.notify()
+
+    def _on_lane_failure(self, lane: _Lane, exc: BaseException) -> None:
+        """Fleet supervision, run on the dying thread as its last act:
+        record and count the death, release any dispatch slot it held,
+        re-bind its sticky families (and re-home its parked batches) to
+        healthy lanes — or drop the bindings entirely in a single-lane
+        fleet so the replacement worker re-binds on its next claim —
+        then spawn the replacement with a crash-loop backoff."""
+        kind = classify(exc)
+        lane.fail_streak += 1
+        self.metrics.observe_lane_failure()
+        self.recorder.record(
+            "lane-failed", lane=lane.index, error_kind=kind,
+            error=f"{type(exc).__name__}: {exc}"[:300],
+            fail_streak=lane.fail_streak,
+        )
+        moved = []
+        with self._dispatch_lock:
+            if lane.busy:
+                # died mid-dispatch bookkeeping: release the slot so
+                # quiescence and wave-width stay truthful
+                lane.busy = False
+                self._active_dispatches = max(
+                    0, self._active_dispatches - 1
+                )
+            healthy = [
+                l for l in self._lanes
+                if l is not lane and l.alive() and not l.abandoned
+            ]
+            if healthy:
+                ring = itertools.cycle(healthy)
+                for compat, idx in list(self._family_lane.items()):
+                    if idx == lane.index:
+                        tgt = next(ring).index
+                        self._family_lane[compat] = tgt
+                        moved.append((compat, tgt))
+                for b in self._parked:
+                    if b.lane == lane.index and not b.running:
+                        b.lane = next(ring).index
+            else:
+                for compat, idx in list(self._family_lane.items()):
+                    if idx == lane.index:
+                        self._family_lane.pop(compat)
+                        self._binding_used.pop(compat, None)
+                        moved.append((compat, None))
+        for compat, tgt in moved:
+            self.metrics.observe_rebind()
+            self.recorder.record(
+                "family-rebound", compat=compat,
+                from_lane=lane.index, to_lane=tgt,
+            )
+        self._restart_lane(lane)
+        self.queue.notify()
+
+    def _restart_lane(self, lane: _Lane) -> bool:
+        if (
+            self.lane_restart_limit
+            and lane.restarts >= self.lane_restart_limit
+        ):
+            lane.abandoned = True
+            self.recorder.record(
+                "lane-abandoned", lane=lane.index,
+                restarts=lane.restarts,
+            )
+            return False
+        # crash-loop backoff paid by the dying thread — the rest of the
+        # fleet keeps serving while this lane sits out
+        time.sleep(min(1.0, 0.05 * lane.fail_streak))
+        with self._worker_lock:
+            if self._stop.is_set():
+                return False
+            lane.restarts += 1
+            lane.thread = threading.Thread(
+                target=self._loop, args=(lane.index,), daemon=True,
+                name=f"witt-serve-lane-{lane.index}",
+            )
+            lane.thread.start()
+        self.metrics.observe_lane_restart()
+        self.recorder.record(
+            "lane-restart", lane=lane.index, restarts=lane.restarts,
+        )
+        return True
+
+    def _reap_bindings(self) -> None:
+        """Expire sticky family→lane bindings that have had no queued
+        job and no parked batch for ``binding_ttl_s`` (the PR-13 leak:
+        bindings lived forever, so a retired family pinned its lane
+        choice for the life of the process).  The family itself — and
+        its compiled programs in the run cache — survives; only the
+        lane decision is re-opened."""
+        now = time.monotonic()
+        expired = []
+        with self._dispatch_lock:
+            if not self._family_lane:
+                return
+            pending = {j.compat for j in self.queue.pending_snapshot()}
+            parked = {b.family.key for b in self._parked}
+            for compat in list(self._family_lane):
+                if compat in pending or compat in parked:
+                    continue
+                last = self._binding_used.get(compat)
+                if last is None:
+                    # bound before use-stamping existed: start the
+                    # clock now instead of expiring on sight
+                    self._binding_used[compat] = now
+                    continue
+                if now - last >= self.binding_ttl_s:
+                    self._family_lane.pop(compat)
+                    self._binding_used.pop(compat, None)
+                    expired.append(compat)
+        for compat in expired:
+            self.metrics.observe_binding_expired()
+            self.recorder.record("binding-expired", compat=compat)
 
     def busy(self) -> bool:
         with self._dispatch_lock:
@@ -1121,14 +1531,121 @@ class BatchScheduler:
             time.sleep(0.02)
         return not self.busy()
 
+    # -- graceful drain -------------------------------------------------
+
+    def _check_admission(self) -> None:
+        if self._draining.is_set():
+            self.recorder.record("admission-rejected", reason="draining")
+            raise DrainingError(self.retry_after_s())
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain(self) -> dict:
+        """Enter graceful drain: admission refuses with DrainingError
+        (HTTP 503 + Retry-After), lanes stop claiming, and in-flight
+        chunked slices checkpoint-stop at their next chunk boundary
+        (the Supervisor's should_stop hook).  Pending jobs stay QUEUED
+        and parked batches keep their checkpoints: undrain() resumes
+        both, bit-identical (the supervisor's replay contract).
+        Idempotent; returns drain_status()."""
+        if not self._draining.is_set():
+            self._draining.set()
+            self.metrics.observe_drain()
+            self.recorder.record(
+                "drain-start", queue_depth=self.queue.depth(),
+                parked=len(self._parked),
+            )
+        self.queue.notify()
+        return self.drain_status()
+
+    def undrain(self) -> dict:
+        if self._draining.is_set():
+            self._draining.clear()
+            self.recorder.record(
+                "drain-end", queue_depth=self.queue.depth(),
+                parked=len(self._parked),
+            )
+        if self.auto_start:
+            self.start()
+        self.queue.notify()
+        return self.drain_status()
+
+    def quiescent(self) -> bool:
+        """True once a drain has settled: no lane is executing anything
+        (parked batches are checkpoints on disk, pending jobs are inert
+        in the queue) — safe to stop the process."""
+        with self._dispatch_lock:
+            active = self._active_dispatches
+        return self._draining.is_set() and active == 0
+
+    def drain_status(self) -> dict:
+        with self._dispatch_lock:
+            active = self._active_dispatches
+            parked = len(self._parked)
+            draining = self._draining.is_set()
+        return {
+            "draining": draining,
+            "quiescent": draining and active == 0,
+            "activeDispatches": active,
+            "parkedBatches": parked,
+            "queueDepth": self.queue.depth(),
+            "retryAfterS": self.retry_after_s(),
+        }
+
     # -- exposition ----------------------------------------------------
+
+    def health(self) -> dict:
+        """Operational snapshot for /w/health and /w/ready: queue
+        pressure, per-lane liveness, drain state, resilience counters,
+        compile-store and error-taxonomy state.  Read-only."""
+        from ..parallel.replica_shard import run_cache_info
+        from ..runtime.compile_store import (
+            compile_store_counters,
+            get_compile_store,
+        )
+        from ..runtime.errors import taxonomy_counters
+
+        with self._dispatch_lock:
+            lanes = [lane.describe() for lane in self._lanes]
+            active = self._active_dispatches
+            parked = len(self._parked)
+            bindings = len(self._family_lane)
+            draining = self._draining.is_set()
+        store = get_compile_store()
+        m = self.metrics
+        return {
+            "queueDepth": self.queue.depth(),
+            "queueCapacity": self.queue.max_depth,
+            "draining": draining,
+            "quiescent": draining and active == 0,
+            "activeDispatches": active,
+            "parkedBatches": parked,
+            "families": len(self._families),
+            "familyBindings": bindings,
+            "lanes": lanes,
+            "lanesAlive": sum(1 for d in lanes if d["alive"]),
+            "laneFailuresTotal": m.lane_failures_total,
+            "laneRestartsTotal": m.lane_restarts_total,
+            "quarantinedTotal": m.jobs_quarantined,
+            "salvageBatchesTotal": m.salvage_batches_total,
+            "compileStore": {
+                "enabled": store is not None,
+                "counters": compile_store_counters(),
+            },
+            "runCache": run_cache_info(),
+            "errorKinds": taxonomy_counters(),
+        }
 
     def status(self) -> dict:
         return {
             "queueDepth": self.queue.depth(),
             "queueCapacity": self.queue.max_depth,
+            "draining": self._draining.is_set(),
             "parkedBatches": len(self._parked),
             "families": len(self._families),
+            "familyBindings": len(self._family_lane),
             "maxBatchReplicas": self.max_batch_replicas,
             "retryAfterS": self.retry_after_s(),
             "deviceGroups": self.device_groups,
@@ -1138,4 +1655,18 @@ class BatchScheduler:
         }
 
     def add_prometheus(self, p) -> None:
+        from ..runtime.errors import taxonomy_counters
+
         self.metrics.add_prometheus(p, self.queue)
+        p.add(
+            "serve_draining",
+            1 if self._draining.is_set() else 0,
+            "1 while the scheduler is in graceful drain",
+            "gauge",
+        )
+        for kind, n in sorted(taxonomy_counters().items()):
+            p.add(
+                "runtime_error_kind_total", n,
+                "classified failures by error-taxonomy kind",
+                "counter", {"kind": kind},
+            )

@@ -27,12 +27,24 @@ including its start/stop asymmetry — /w/nodes/{id}/start vs
                                          handler blocks for the legacy
                                          response shape)
   POST   /w/jobs                         submit a batched job (202; 429 +
-                                         Retry-After when the queue is full)
+                                         Retry-After when the queue is full;
+                                         503 + Retry-After while draining)
   GET    /w/jobs                         job list + scheduler status
   GET    /w/jobs/{id}                    job status + streamed progress
-  GET    /w/jobs/{id}/result             result (optional ?waitS= blocking)
+  GET    /w/jobs/{id}/result             result (optional ?waitS= blocking;
+                                         quarantined jobs answer 422 with
+                                         the error-taxonomy kind)
   DELETE /w/jobs/{id}                    cancel (queued: immediate; running:
                                          dropped at the batch boundary)
+  GET    /w/health                       liveness + fleet snapshot (always
+                                         200 while the process serves HTTP)
+  GET    /w/ready                        readiness: 200 when admitting, 503
+                                         + Retry-After when draining or the
+                                         sim backend is degraded
+  POST   /w/admin/drain                  graceful drain: stop admission,
+                                         checkpoint-park in-flight batches
+  GET    /w/admin/drain                  drain progress (quiescent flag)
+  POST   /w/admin/undrain                resume admission + claiming
 
 The simulation core is single-threaded by design (Network.java:10), so all
 handlers serialize on one lock.  The /w/jobs surface is the multi-tenant
@@ -50,7 +62,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Optional, Tuple
 
-from ..serve import BatchScheduler, JobState, QueueFullError, UnknownJobError
+from ..serve import (
+    BatchScheduler,
+    DrainingError,
+    JobState,
+    QueueFullError,
+    UnknownJobError,
+)
 from .server import Server
 
 _STATIC_DIR = Path(__file__).parent / "static"
@@ -243,6 +261,12 @@ class WServer:
                     503,
                     {"Retry-After": str(e.retry_after_s)},
                 )
+            except DrainingError as e:
+                return Response(
+                    {"error": str(e), "draining": True},
+                    503,
+                    {"Retry-After": str(e.retry_after_s)},
+                )
             if not job.done_event.wait(600.0):
                 return Response(
                     {"error": f"runMs job {job.id} did not finish "
@@ -305,6 +329,12 @@ class WServer:
             return Response(
                 {"error": str(e), "queueFull": True},
                 429,
+                {"Retry-After": str(e.retry_after_s)},
+            )
+        except DrainingError as e:
+            return Response(
+                {"error": str(e), "draining": True},
+                503,
                 {"Retry-After": str(e.retry_after_s)},
             )
         return Response(
@@ -374,7 +404,16 @@ class WServer:
         if job.state is JobState.FAILED:
             return Response(
                 {"id": job.id, "state": job.state.value,
-                 "error": job.error}, 500,
+                 "error": job.error, "errorKind": job.error_kind}, 500,
+            )
+        if job.state is JobState.QUARANTINED:
+            # 4xx on purpose: the job's OWN row poisoned its batch
+            # (scheduler bisection pinned it) — retrying it verbatim
+            # will poison the next batch too, so clients must not
+            return Response(
+                {"id": job.id, "state": job.state.value,
+                 "error": job.error, "errorKind": job.error_kind,
+                 "quarantined": True}, 422,
             )
         if job.state is JobState.CANCELLED:
             return Response(
@@ -494,6 +533,12 @@ class WServer:
                 503,
                 {"Retry-After": str(e.retry_after_s)},
             )
+        except DrainingError as e:
+            return Response(
+                {"error": str(e), "draining": True},
+                503,
+                {"Retry-After": str(e.retry_after_s)},
+            )
         job.done_event.wait(600.0)
         if job.exc is not None:
             raise job.exc  # preserve the legacy error mapping (_invoke)
@@ -505,6 +550,59 @@ class WServer:
                 {"Retry-After": str(self.jobs.retry_after_s())},
             )
         return job.result
+
+    # -- operational surface (health / readiness / drain) --------------------
+    @route("GET", r"/w/health", locked=False)
+    def health(self, body):
+        """Liveness + fleet snapshot: always 200 while the process can
+        serve HTTP (a draining or degraded fleet is still ALIVE — use
+        /w/ready for routability).  The payload is the scheduler's full
+        operational state: queue pressure, per-lane liveness/restarts,
+        drain state, quarantine/salvage counters, compile-store and
+        error-taxonomy counters."""
+        h = self.jobs.health()
+        h["degraded"] = self.degraded
+        if self.degraded_reason:
+            h["degradedReason"] = self.degraded_reason
+        return h
+
+    @route("GET", r"/w/ready", locked=False)
+    def ready(self, body):
+        """Readiness: 200 iff this process should receive NEW work —
+        503 + Retry-After while draining (stop sending, finish soon) or
+        while the sim backend is degraded (re-init required)."""
+        if self.jobs.draining:
+            return Response(
+                {"ready": False, "reason": "draining",
+                 "drain": self.jobs.drain_status()},
+                503,
+                {"Retry-After": str(self.jobs.retry_after_s())},
+            )
+        if self.degraded:
+            return Response(
+                {"ready": False, "reason": "degraded",
+                 "error": self.degraded_reason},
+                503,
+                {"Retry-After": "30"},
+            )
+        return {"ready": True, "queueDepth": self.jobs.queue.depth()}
+
+    @route("POST", r"/w/admin/drain", locked=False)
+    def drain(self, body):
+        """Graceful drain: admission starts answering 503 +
+        Retry-After, lanes stop claiming, in-flight chunked batches
+        checkpoint-stop at their next chunk boundary.  Poll GET
+        /w/admin/drain until ``quiescent`` before stopping the process;
+        pending jobs and parked checkpoints survive for undrain."""
+        return self.jobs.drain()
+
+    @route("GET", r"/w/admin/drain", locked=False)
+    def drain_progress(self, body):
+        return self.jobs.drain_status()
+
+    @route("POST", r"/w/admin/undrain", locked=False)
+    def undrain(self, body):
+        return self.jobs.undrain()
 
     # -- dispatch ------------------------------------------------------------
     def dispatch(self, method: str, path: str, body: str) -> Tuple[int, object]:
