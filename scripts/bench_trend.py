@@ -98,6 +98,19 @@ def _load_serve(root: str):
         return None
 
 
+def _load_mesh(root: str):
+    """The 2D-mesh rung-ladder record (BENCH_MESH.json,
+    witt-bench-mesh/v1, written by scripts/tpu_campaign.py
+    --mesh-ladder): per-(P_replica, P_node) wall time, sims/s,
+    bit-identity vs the unsharded singleton and the 1/P channel-
+    ownership verdict.  Optional — absent until the ladder has run."""
+    try:
+        with open(os.path.join(root, "BENCH_MESH.json")) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 def _round_row(path: str, budget) -> dict:
     with open(path) as f:
         doc = json.load(f)
@@ -201,6 +214,7 @@ def build_trend(root: str = ROOT) -> dict:
         "regressions": regressions,
         "budget": _load_budget(root),
         "serve": _load_serve(root),
+        "mesh": _load_mesh(root),
     }
     return trend
 
@@ -243,6 +257,25 @@ def check(trend: dict) -> list:
             "BENCH_SERVE.json records a failed serve benchmark: "
             + "; ".join(serve.get("failures", ["unknown"]))[:300]
         )
+    # same discipline for the 2D-mesh ladder: a committed record whose
+    # rungs broke bit-identity or channel ownership must not pass CI
+    mesh = trend.get("mesh")
+    if mesh is not None:
+        if mesh.get("schema") != "witt-bench-mesh/v1":
+            problems.append(
+                f"BENCH_MESH.json has unknown schema "
+                f"{mesh.get('schema')!r} (expected witt-bench-mesh/v1)"
+            )
+        elif not mesh.get("ok", False):
+            bad = [
+                f"({r.get('p_replica')},{r.get('p_node')})"
+                for r in mesh.get("rungs", [])
+                if not (r.get("bit_identical") and r.get("ownership_ok"))
+            ]
+            problems.append(
+                "BENCH_MESH.json records a failed 2D-mesh ladder"
+                + (f" — rungs {', '.join(bad)}" if bad else " (no rungs)")
+            )
     return problems
 
 

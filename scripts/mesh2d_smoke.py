@@ -1,0 +1,227 @@
+"""2D-mesh dryrun smoke: the composed (replicas, nodes) mesh must be
+bit-identical to the unsharded singleton.
+
+For a spread of registered protocols — PingPong and P2PFlood on the
+default time-wheel store, Handel (the aggregation family whose in_sig
+channel arrays the dryrun's 1/P ownership invariant was written for)
+and a telemetry-armed Handel config — the smoke:
+
+  1. runs the stacked batch unsharded (the reference),
+  2. places it on a 2D (2, 4) mesh2d layout over 8 forced host devices
+     — replica rows on axis 0, node columns on axis 1, message store /
+     telemetry / fault side-cars replicated along ``nodes`` — and
+     asserts every NODE-COLUMN leaf holds exactly total_bytes/8 per
+     device (the generalized 1/P ownership check; for Handel the
+     channel-specific assert_channel_ownership runs too),
+  3. runs the same program partitioned over both axes at once and
+     asserts the result is BITWISE identical to the reference, leaf by
+     leaf — the same bar as flat-vs-wheel and fused-vs-unfused,
+  4. repeats the run on the transposed (4, 2) mesh for Handel, proving
+     the run cache keeps the two geometries as distinct programs.
+
+Exit 0 with a JSON summary in <outdir>/mesh2d_smoke.json on success;
+exit 1 naming the first violated invariant otherwise.  CI runs this
+under tier1.yml; locally:
+
+  env JAX_PLATFORMS=cpu python scripts/mesh2d_smoke.py mesh2d_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# 8 virtual host devices BEFORE jax import, honoring any explicit
+# override (same discipline as __graft_entry__ / tests/conftest.py)
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+N_REPLICAS = 8
+SIM_MS = 200
+
+
+def _configs():
+    """(name, net, state, needs_channel_assert) for each smoke config."""
+    from wittgenstein_tpu.core.registries import registry_batched_protocols
+    from wittgenstein_tpu.telemetry.state import TelemetryConfig
+
+    out = []
+    for proto in ("pingpong", "p2pflood", "handel"):
+        net, state = registry_batched_protocols.get(proto).factory()
+        out.append((proto, net, state, proto == "handel"))
+    # telemetry-armed: the counter side-car must classify as replicated
+    # along the node axis and stay bitwise through the partitioned run
+    net, state = registry_batched_protocols.get("handel").factory()
+    tnet, tstate = net.with_telemetry(state, TelemetryConfig())
+    out.append(("handel+telemetry", tnet, tstate, True))
+    return out
+
+
+def _leaves(tree):
+    import jax
+    import numpy as np
+
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def _assert_node_column_ownership(net, placed, n_devices, fail):
+    """Every node-column leaf of the placed state must hold exactly
+    total/n_devices bytes per device — the 1/P invariant over BOTH mesh
+    axes at once (replica rows and node columns each contribute their
+    factor)."""
+    import jax
+
+    from wittgenstein_tpu.parallel import classify_leaf
+
+    checked = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(placed)[0]:
+        key = jax.tree_util.keystr(path)
+        cls = classify_leaf(key, tuple(leaf.shape), net.n_nodes)
+        if cls != "node-column" or not hasattr(leaf, "addressable_shards"):
+            continue
+        per_dev = max(s.data.nbytes for s in leaf.addressable_shards)
+        if per_dev != leaf.nbytes // n_devices:
+            fail(
+                f"ownership violated for {key}: {per_dev} B/device, "
+                f"want {leaf.nbytes // n_devices} "
+                f"({leaf.nbytes} B / {n_devices})"
+            )
+        checked += 1
+    if checked == 0:
+        fail("no node-column leaves found — ownership unverifiable")
+    return checked
+
+
+def main(outdir: str) -> int:
+    os.makedirs(outdir, exist_ok=True)
+    import jax
+
+    from wittgenstein_tpu.engine import replicate_state
+    from wittgenstein_tpu.parallel import (
+        assert_channel_ownership,
+        make_mesh2d_layout,
+        run_cache_info,
+        sharded_run_stats,
+    )
+
+    n_devices = jax.device_count()
+    failures = []
+
+    def fail(msg):
+        print(f"mesh2d_smoke FAIL: {msg}", file=sys.stderr)
+        failures.append(msg)
+
+    if n_devices != 8:
+        fail(f"expected 8 forced host devices, found {n_devices}")
+        _write(outdir, [], failures, n_devices)
+        return 1
+
+    results = []
+    for name, net, state, channel_assert in _configs():
+        t0 = time.perf_counter()
+        states = replicate_state(state, N_REPLICAS)
+        ref_out, ref_stats = sharded_run_stats(net, states, SIM_MS)
+        ref_leaves = _leaves(ref_out)
+
+        geometries = [(2, 4)] + ([(4, 2)] if channel_assert else [])
+        for p_replica, p_node in geometries:
+            layout = make_mesh2d_layout(p_replica, p_node)
+            placed = layout.place(net, states)
+            cols = _assert_node_column_ownership(
+                net, placed, n_devices, fail
+            )
+            channels = 0
+            if channel_assert:
+                try:
+                    channels = len(
+                        assert_channel_ownership(net, placed, n_devices)
+                    )
+                except AssertionError as e:
+                    fail(f"{name} ({p_replica},{p_node}): {e}")
+            out, stats = sharded_run_stats(
+                net, states, SIM_MS, layout=layout
+            )
+            jax.block_until_ready(out)
+            mismatched = [
+                i
+                for i, (a, b) in enumerate(zip(_leaves(out), ref_leaves))
+                if not (a == b).all()
+            ]
+            if mismatched:
+                fail(
+                    f"{name} ({p_replica},{p_node}): {len(mismatched)} "
+                    f"leaves differ from the unsharded singleton "
+                    f"(first index {mismatched[0]})"
+                )
+            results.append(
+                {
+                    "config": name,
+                    "p_replica": p_replica,
+                    "p_node": p_node,
+                    "node_columns_checked": cols,
+                    "channels_checked": channels,
+                    "bit_identical": not mismatched,
+                    "wall_s": round(time.perf_counter() - t0, 2),
+                }
+            )
+            print(
+                f"mesh2d_smoke: {name} ({p_replica},{p_node}) "
+                f"bit_identical={not mismatched} node_columns={cols} "
+                f"channels={channels}",
+                flush=True,
+            )
+
+    # the transposed Handel geometries must be DISTINCT cached programs
+    info = run_cache_info()
+    handel_entries = [
+        r for r in results if r["config"] == "handel"
+    ]
+    if len(handel_entries) == 2 and info["size"] < 3:
+        fail(
+            f"run cache holds {info['size']} entries — the (2,4) and "
+            "(4,2) Handel programs collapsed into one key"
+        )
+
+    _write(outdir, results, failures, n_devices)
+    if failures:
+        return 1
+    print(
+        f"mesh2d_smoke: PASS — {len(results)} partitioned runs, all "
+        "bitwise identical to the unsharded singleton",
+        flush=True,
+    )
+    return 0
+
+
+def _write(outdir, results, failures, n_devices):
+    with open(os.path.join(outdir, "mesh2d_smoke.json"), "w") as f:
+        json.dump(
+            {
+                "schema": "witt-mesh2d-smoke/v1",
+                "n_devices": n_devices,
+                "n_replicas": N_REPLICAS,
+                "sim_ms": SIM_MS,
+                "runs": results,
+                "ok": not failures,
+                "failures": failures,
+            },
+            f,
+            indent=2,
+            sort_keys=True,
+        )
+        f.write("\n")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1] if len(sys.argv) > 1 else "mesh2d_smoke"))

@@ -73,6 +73,9 @@ CHECKPOINT_EVERY = int(os.environ.get("WITT_CAMPAIGN_CKPT_EVERY", "5"))
 
 def log(rec: dict) -> None:
     rec = dict(rec, ts=round(time.time(), 1))
+    parent = os.path.dirname(os.path.abspath(OUT))
+    if parent and not os.path.isdir(parent):
+        os.makedirs(parent, exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(rec) + "\n")
     print(json.dumps(rec), flush=True)
@@ -92,6 +95,16 @@ def _events() -> list:
 def done_rungs() -> set:
     return {
         (r["nodes"], r["replicas"]) for r in _events() if r.get("event") == "rung"
+    }
+
+
+def done_mesh_rungs() -> set:
+    """Resume keys for the 2D-mesh ladder: one per completed
+    (nodes, replicas, p_replica, p_node) rung in the jsonl."""
+    return {
+        (r["nodes"], r["replicas"], r["p_replica"], r["p_node"])
+        for r in _events()
+        if r.get("event") == "mesh_rung"
     }
 
 
@@ -303,6 +316,181 @@ def campaign() -> None:
     log({"event": "campaign_end"})
 
 
+MESH_SCHEMA = "witt-bench-mesh/v1"
+MESH_NODES = int(os.environ.get("WITT_MESH_NODES", "64"))
+MESH_REPLICAS = int(os.environ.get("WITT_MESH_REPLICAS", "8"))
+MESH_SIM_MS = int(os.environ.get("WITT_MESH_SIM_MS", "300"))
+
+
+def _mesh_ladder_rungs(n_devices: int) -> list:
+    """The P_replica × P_node sweep: every (p_r, p_n) factorization of
+    the visible device count whose node axis divides the node count and
+    whose replica axis divides the replica rows.  Includes the (D, 1)
+    pure-replica rung — the 1D baseline every 2D rung is judged
+    against."""
+    rungs = []
+    for p_node in range(1, n_devices + 1):
+        if n_devices % p_node != 0:
+            continue
+        p_replica = n_devices // p_node
+        if MESH_NODES % p_node != 0 or MESH_REPLICAS % p_replica != 0:
+            continue
+        rungs.append((p_replica, p_node))
+    return rungs
+
+
+def mesh_ladder(out_json: "str | None" = None) -> None:
+    """Child mode: the resumable 2D-mesh rung ladder.  Each rung places
+    the SAME replicated state on a (p_replica, p_node) mesh2d layout,
+    runs the cached partitioned program, and records wall time +
+    bit-identity against the unsharded singleton + the 1/P channel-
+    ownership audit.  Completed rungs (mesh_rung events in the jsonl)
+    are skipped on re-entry, so a wedge-killed ladder resumes where it
+    stopped.  Every completed entry lands in BENCH_MESH.json
+    (witt-bench-mesh/v1), which bench_trend.py ingests."""
+    import threading
+
+    import numpy as np
+
+    threading.Thread(target=_phase_watchdog, daemon=True).start()
+
+    import jax
+
+    if ALLOW_CPU:
+        jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, ROOT)
+    import bench as benchmod
+    from wittgenstein_tpu.engine import replicate_state
+    from wittgenstein_tpu.parallel import (
+        assert_channel_ownership,
+        make_mesh2d_layout,
+        sharded_run_stats,
+    )
+    from wittgenstein_tpu.protocols.handel_batched import make_handel
+
+    dev = jax.devices()[0]
+    n_devices = jax.device_count()
+    log({"event": "mesh_ladder_start", "device": str(dev),
+         "n_devices": n_devices, "nodes": MESH_NODES,
+         "replicas": MESH_REPLICAS, "sim_ms": MESH_SIM_MS})
+    if dev.platform != "tpu" and not ALLOW_CPU:
+        log({"event": "abort", "reason": f"platform {dev.platform} != tpu"})
+        return
+
+    net, state0 = make_handel(benchmod._params(MESH_NODES))
+    states = replicate_state(state0, MESH_REPLICAS)
+    skip = done_mesh_rungs()
+    rungs = _mesh_ladder_rungs(n_devices)
+    if not rungs:
+        log({"event": "abort",
+             "reason": f"no (p_replica, p_node) factorization of "
+                       f"{n_devices} devices fits nodes={MESH_NODES} "
+                       f"replicas={MESH_REPLICAS}"})
+        return
+
+    # the unsharded singleton: the bit-identity reference every rung is
+    # compared against (same bar as flat-vs-wheel / fused-vs-unfused)
+    _phase_deadline[0] = time.time() + COMPILE_LIMIT_S
+    ref_out, _ = sharded_run_stats(net, states, MESH_SIM_MS)
+    jax.block_until_ready(ref_out)
+    _phase_deadline[0] = None
+    ref_leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(ref_out)]
+
+    for p_replica, p_node in rungs:
+        key = (MESH_NODES, MESH_REPLICAS, p_replica, p_node)
+        if key in skip:
+            log({"event": "mesh_rung_cached", "nodes": MESH_NODES,
+                 "replicas": MESH_REPLICAS, "p_replica": p_replica,
+                 "p_node": p_node})
+            continue
+        layout = make_mesh2d_layout(p_replica, p_node)
+        log({"event": "mesh_compiling", "p_replica": p_replica,
+             "p_node": p_node, "limit_s": COMPILE_LIMIT_S})
+        _phase_deadline[0] = time.time() + COMPILE_LIMIT_S
+        placed = layout.place(net, states)
+        owned = assert_channel_ownership(net, placed, n_devices)
+        t0 = time.perf_counter()
+        out, _stats = sharded_run_stats(net, states, MESH_SIM_MS,
+                                        layout=layout)
+        jax.block_until_ready(out)
+        warm_s = time.perf_counter() - t0
+        _phase_deadline[0] = time.time() + CHUNK_LIMIT_S
+        t0 = time.perf_counter()
+        out, _stats = sharded_run_stats(net, states, MESH_SIM_MS,
+                                        layout=layout)
+        jax.block_until_ready(out)
+        run_s = time.perf_counter() - t0
+        _phase_deadline[0] = None
+        bit_identical = all(
+            (np.asarray(a) == b).all()
+            for a, b in zip(jax.tree_util.tree_leaves(out), ref_leaves)
+        )
+        per_dev_b = max(b for b, _t in owned.values())
+        rec = {
+            "event": "mesh_rung", "nodes": MESH_NODES,
+            "replicas": MESH_REPLICAS, "p_replica": p_replica,
+            "p_node": p_node, "sim_ms": MESH_SIM_MS,
+            "warm_s": round(warm_s, 3), "run_s": round(run_s, 3),
+            "sims_per_sec": round(MESH_REPLICAS / run_s, 4),
+            "bit_identical": bool(bit_identical),
+            "ownership_ok": True,
+            "channels": len(owned),
+            "channel_bytes_per_device": int(per_dev_b),
+        }
+        log(rec)
+
+    _write_mesh_record(out_json)
+    log({"event": "mesh_ladder_end"})
+
+
+def _write_mesh_record(out_json: "str | None" = None) -> None:
+    """Assemble BENCH_MESH.json from every mesh_rung event matching the
+    current ladder geometry — resumed ladders re-emit the full record."""
+    import jax
+
+    rungs = [
+        {k: v for k, v in r.items() if k not in ("event", "ts")}
+        for r in _events()
+        if r.get("event") == "mesh_rung"
+        and r.get("nodes") == MESH_NODES
+        and r.get("replicas") == MESH_REPLICAS
+        and r.get("sim_ms") == MESH_SIM_MS
+    ]
+    # last write wins per (p_replica, p_node): a re-run rung supersedes
+    by_shape = {(r["p_replica"], r["p_node"]): r for r in rungs}
+    rungs = [by_shape[k] for k in sorted(by_shape)]
+    ok = bool(rungs) and all(
+        r.get("bit_identical") and r.get("ownership_ok") for r in rungs
+    )
+    record = {
+        "schema": MESH_SCHEMA,
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "nodes": MESH_NODES,
+        "replicas": MESH_REPLICAS,
+        "sim_ms": MESH_SIM_MS,
+        "rungs": rungs,
+        "ok": ok,
+        "best": (
+            max(rungs, key=lambda r: r["sims_per_sec"]) if rungs else None
+        ),
+    }
+    path = out_json or os.environ.get(
+        "WITT_MESH_OUT", os.path.join(ROOT, "BENCH_MESH.json")
+    )
+    parent = os.path.dirname(os.path.abspath(path))
+    if parent and not os.path.isdir(parent):
+        os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    log({"event": "mesh_record", "path": path, "ok": ok,
+         "rungs": len(rungs)})
+
+
 def _mtime() -> float:
     try:
         return os.path.getmtime(OUT)
@@ -370,5 +558,7 @@ def supervise() -> None:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--run":
         campaign()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--mesh-ladder":
+        mesh_ladder(sys.argv[2] if len(sys.argv) > 2 else None)
     else:
         supervise()

@@ -25,6 +25,7 @@ from wittgenstein_tpu.runtime.compile_store import (
     durable_jit,
     geometry_signature,
     get_compile_store,
+    mesh_geometry_signature,
     set_compile_store,
 )
 
@@ -119,6 +120,7 @@ class TestInvalidation:
             ("device_count", "999"),
             ("format", "witt-compile-store/v0"),
             ("stable_key", "prog/other"),
+            ("mesh_geometry", "replicas=4,nodes=2"),
         ],
     )
     def test_stale_environment_falls_back(self, store, field, value):
@@ -189,7 +191,10 @@ class TestDurableJit:
         fn = lambda v: v - 1.0  # noqa: E731
         cold = durable_jit(fn, "djit/corrupt", store)
         want = np.asarray(cold(x))
-        key = f"djit/corrupt/geom-{geometry_signature((x,))}"
+        key = (
+            f"djit/corrupt/mesh-{mesh_geometry_signature((x,))}"
+            f"/geom-{geometry_signature((x,))}"
+        )
         _, bin_path = store._paths(key)
         with open(bin_path, "wb") as f:
             f.write(b"\x00garbage")
@@ -205,6 +210,54 @@ class TestDurableJit:
         assert dj.compiles == 2
         dj(jnp.zeros(4, jnp.float32))
         assert dj.compiles == 2
+
+
+class TestMeshGeometry:
+    """ISSUE-16 row of the invalidation matrix: a program persisted
+    under a (2,4) mesh must never satisfy a (4,2) request — the two
+    partition the same 8 devices differently, so they get distinct
+    entry names AND a manifest-level mesh_geometry check."""
+
+    def _mesh_sharding(self, p_replica, p_node):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = np.array(jax.devices()[:8]).reshape(p_replica, p_node)
+        mesh = Mesh(devs, ("replicas", "nodes"))
+        return NamedSharding(mesh, P("replicas", "nodes"))
+
+    def test_transposed_meshes_get_distinct_entries(self, store):
+        fn = lambda v: v + 1.0  # noqa: E731
+        dj = durable_jit(fn, "djit/mesh", store)
+        x24 = jax.device_put(
+            jnp.zeros((8, 8), jnp.float32), self._mesh_sharding(2, 4)
+        )
+        x42 = jax.device_put(
+            jnp.zeros((8, 8), jnp.float32), self._mesh_sharding(4, 2)
+        )
+        assert (
+            mesh_geometry_signature((x24,))
+            != mesh_geometry_signature((x42,))
+        )
+        dj(x24)
+        dj(x42)
+        assert dj.compiles == 2  # no collision in memory...
+        keys = {e["stable_key"] for e in store.entries()}
+        assert len(keys) == 2  # ...and two distinct store entries
+        assert any("mesh-replicas=2,nodes=4" in k for k in keys)
+        assert any("mesh-replicas=4,nodes=2" in k for k in keys)
+
+    def test_mesh_geometry_mismatch_is_stale(self, store):
+        compiled, _ = _compiled()
+        assert store.put("prog/m", compiled,
+                         mesh_geometry="replicas=2,nodes=4")
+        c0 = compile_store_counters()
+        assert store.get("prog/m",
+                         mesh_geometry="replicas=4,nodes=2") is None
+        d = _delta(c0, compile_store_counters())
+        assert d["stale"] == 1 and d["hits"] == 0
+        # the matching geometry still hits
+        assert store.get("prog/m",
+                         mesh_geometry="replicas=2,nodes=4") is not None
 
 
 class TestProcessDefault:

@@ -79,3 +79,85 @@ class TestPlacement:
         assert isinstance(g, DeviceGroup)
         assert g.mesh.devices.shape == (len(g.devices),)
         assert g.label().startswith("group0[")
+
+
+class Test2DGroups:
+    """ISSUE 16: a lane's mesh can fold in a node axis — node_parallel=P
+    gives the group a (len(devices)//P, P) (replicas, nodes) sub-mesh
+    whose placement additionally shards node columns, while the default
+    node_parallel=1 stays the flat one-axis lane bit-for-bit."""
+
+    def _net_states(self, rows=4):
+        from wittgenstein_tpu.core.registries import (
+            registry_batched_protocols,
+        )
+        from wittgenstein_tpu.engine import replicate_state
+
+        net, state = registry_batched_protocols.get("pingpong").factory()
+        return net, replicate_state(state, rows)
+
+    def test_mesh_shape_layout_and_label(self):
+        g = make_device_groups(2, node_parallel=2)[0]
+        assert g.replica_parallel == 2 and g.node_parallel == 2
+        assert g.mesh.devices.shape == (2, 2)
+        assert g.mesh.axis_names == ("replicas", "nodes")
+        lay = g.layout()
+        assert lay.p_replica == 2 and lay.p_node == 2
+        assert g.label() == "group0[2x2]"
+
+    def test_flat_group_unchanged(self):
+        g = make_device_groups(2)[0]
+        assert g.node_parallel == 1
+        assert g.mesh.devices.shape == (len(g.devices),)
+        lay = g.layout()
+        assert lay.node_axis is None and lay.p_node == 1
+
+    def test_place_with_net_shards_node_columns(self):
+        from jax.sharding import PartitionSpec as P
+
+        net, states = self._net_states(rows=4)
+        g = make_device_groups(2, node_parallel=2)[1]
+        placed = g.place(states, net=net)
+        specs = set()
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(placed)[0]:
+            assert leaf.sharding.device_set == set(g.devices), kp
+            specs.add(tuple(leaf.sharding.spec))
+        # node columns picked up the node axis; store/scalars did not
+        assert tuple(P("replicas", "nodes")) in specs
+        assert tuple(P("replicas")) in specs
+        # bytes are placement-independent
+        for a, b in zip(jax.tree_util.tree_leaves(states),
+                        jax.tree_util.tree_leaves(placed)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_place_without_net_replica_shards_only(self):
+        from jax.sharding import PartitionSpec as P
+
+        _net, states = self._net_states(rows=4)
+        g = make_device_groups(2, node_parallel=2)[0]
+        placed = g.place(states)
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(placed)[0]:
+            assert leaf.sharding.device_set == set(g.devices), kp
+            assert tuple(leaf.sharding.spec) == tuple(P("replicas")), kp
+
+    def test_indivisible_node_count_falls_back_to_replica_shard(self):
+        from jax.sharding import PartitionSpec as P
+
+        _net, states = self._net_states(rows=4)
+
+        class _OddNet:  # n_nodes the node axis cannot split evenly
+            n_nodes = 7
+
+        g = make_device_groups(2, node_parallel=2)[0]
+        placed = g.place(states, net=_OddNet())
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(placed)[0]:
+            assert leaf.sharding.device_set == set(g.devices), kp
+            assert tuple(leaf.sharding.spec) == tuple(P("replicas")), kp
+
+    def test_invalid_node_parallel_rejected(self):
+        with pytest.raises(ValueError):
+            make_device_groups(2, node_parallel=3)  # 3 !| 4 per group
+        with pytest.raises(ValueError):
+            make_device_groups(2, node_parallel=0)
+        with pytest.raises(ValueError):
+            DeviceGroup(0, tuple(jax.devices()[:4]), node_parallel=3)

@@ -1,0 +1,224 @@
+"""2D device mesh (parallel/mesh2d.py, ISSUE 16).
+
+The composed (replicas, nodes) mesh's contract, on the 8-device virtual
+CPU mesh conftest forces: a state placed on a ``Mesh((P_r, P_n))`` runs
+``run_ms_batched`` bitwise identical to the unsharded singleton, every
+aggregation channel holds exactly 1/(P_r*P_n) of its bytes per device,
+the run cache keys on the layout's geometry so (2,4) and (4,2) are
+distinct programs, and the leaf classification rule agrees between the
+single-state and stacked views.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.core.registries import registry_batched_protocols
+from wittgenstein_tpu.engine import replicate_state
+from wittgenstein_tpu.parallel import (
+    MeshLayout,
+    assert_channel_ownership,
+    channel_ownership,
+    classify_leaf,
+    make_mesh2d,
+    make_mesh2d_layout,
+    sharded_run_stats,
+)
+from wittgenstein_tpu.parallel.node_shard import _MESSAGE_STORE_FIELDS
+
+R = 8
+SIM_MS = 120
+
+
+def _entry_states(name):
+    net, state = registry_batched_protocols.get(name).factory()
+    return net, replicate_state(state, R)
+
+
+def _assert_bitwise(got, want):
+    gl = jax.tree_util.tree_leaves(got)
+    wl = jax.tree_util.tree_leaves(want)
+    assert len(gl) == len(wl)
+    for g, w in zip(gl, wl):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+class TestClassify:
+    def test_store_fields_excluded_by_name(self):
+        # a wheel dim that coincides with n_nodes must NOT become a
+        # node column — the exclusion is by name, not by shape
+        for f in (".msg_arrival", ".tele", ".faults", ".whl_fill"):
+            assert f in _MESSAGE_STORE_FIELDS
+            assert (
+                classify_leaf(f"{f}[0]", (R, 64, 3), 64, stacked=True)
+                == "replica-row"
+            )
+
+    def test_node_dim_offset(self):
+        # stacked states look past the leading replica dim; single
+        # states classify dim 0 directly
+        assert classify_leaf(".proto['x']", (R, 64), 64, stacked=True) \
+            == "node-column"
+        assert classify_leaf(".proto['x']", (64,), 64, stacked=False) \
+            == "node-column"
+        assert classify_leaf(".time", (R,), 64, stacked=True) \
+            == "replica-row"
+        assert classify_leaf(".time", (), 64, stacked=False) \
+            == "replicated"
+
+    def test_stacked_single_agreement(self):
+        # the SL1001 invariant, spot-checked on a real state
+        net, state = registry_batched_protocols.get("handel").factory()
+        n = net.n_nodes
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+            key = jax.tree_util.keystr(kp)
+            shape = tuple(leaf.shape)
+            single = classify_leaf(key, shape, n, stacked=False)
+            stacked = classify_leaf(key, (2,) + shape, n, stacked=True)
+            want = "node-column" if single == "node-column" \
+                else "replica-row"
+            assert stacked == want, key
+
+
+class TestLayoutConstruction:
+    def test_mesh_product_must_match_devices(self):
+        n = len(jax.devices())
+        with pytest.raises(ValueError):
+            make_mesh2d(2, n)  # 2n devices needed
+        with pytest.raises(ValueError):
+            make_mesh2d(0, n)
+
+    def test_layout_needs_an_active_axis(self):
+        mesh = make_mesh2d(2, 4)
+        with pytest.raises(ValueError):
+            MeshLayout(mesh, replica_axis=None, node_axis=None)
+        with pytest.raises(ValueError):
+            MeshLayout(mesh, replica_axis="bogus")
+
+    def test_geometry_distinguishes_transposed_meshes(self):
+        a = make_mesh2d_layout(2, 4)
+        b = make_mesh2d_layout(4, 2)
+        assert a.geometry() != b.geometry()
+        assert a.p_replica == 2 and a.p_node == 4
+        assert a.n_devices == b.n_devices == 8
+        assert a.describe() == "mesh[replicas=2,nodes=4]"
+
+    def test_validate_rejects_indivisible(self):
+        net, states = _entry_states("handel")
+        lay = make_mesh2d_layout(2, 4)
+        bad_rows = jax.tree_util.tree_map(
+            lambda a: a[: R - 1] if a.shape and a.shape[0] == R else a,
+            states,
+        )
+        with pytest.raises(ValueError, match="replica rows"):
+            lay.validate(net, bad_rows)
+        # 8-wide node axis only divides n_nodes when n_nodes % 8 == 0;
+        # fake an engine whose node count can't split 4 ways
+        class _FakeNet:
+            n_nodes = 6
+
+        with pytest.raises(ValueError, match="n_nodes"):
+            lay.validate(_FakeNet(), states)
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("name", ["handel", "pingpong"])
+    def test_2d_run_matches_unsharded(self, name):
+        # pingpong is a wheel-mode protocol (DEFAULT_WHEEL_ROWS) — the
+        # wheel/overflow store replicates along nodes and must still be
+        # bitwise; handel is the channel-heavy aggregation case
+        net, states = _entry_states(name)
+        ref = net.run_ms_batched(states, SIM_MS)
+        layout = make_mesh2d_layout(2, 4)
+        placed = layout.place(net, states)
+        out = net.run_ms_batched(placed, SIM_MS)
+        _assert_bitwise(out, ref)
+
+    def test_transposed_mesh_matches_too(self):
+        net, states = _entry_states("handel")
+        ref = net.run_ms_batched(states, SIM_MS)
+        out = net.run_ms_batched(
+            make_mesh2d_layout(4, 2).place(net, states), SIM_MS
+        )
+        _assert_bitwise(out, ref)
+
+    def test_telemetry_armed_2d_matches(self):
+        from wittgenstein_tpu.telemetry.state import TelemetryConfig
+
+        net, state = registry_batched_protocols.get("handel").factory()
+        tnet, tstate = net.with_telemetry(state, TelemetryConfig())
+        states = replicate_state(tstate, R)
+        ref = tnet.run_ms_batched(states, SIM_MS)
+        out = tnet.run_ms_batched(
+            make_mesh2d_layout(2, 4).place(tnet, states), SIM_MS
+        )
+        _assert_bitwise(out, ref)
+
+
+class TestChannelOwnership:
+    def test_channels_hold_one_over_p(self):
+        net, states = _entry_states("handel")
+        for p_r, p_n in ((2, 4), (4, 2)):
+            layout = make_mesh2d_layout(p_r, p_n)
+            placed = layout.place(net, states)
+            owned = assert_channel_ownership(net, placed)
+            assert owned  # at least one in_sig channel audited
+            for per_dev, total in owned.values():
+                assert per_dev * 8 == total
+
+    def test_unsharded_ownership_fails(self):
+        net, states = _entry_states("handel")
+        with pytest.raises(AssertionError, match="ownership"):
+            assert_channel_ownership(net, states)
+
+    def test_no_channels_is_an_error(self):
+        # pingpong has no aggregation channels: the audit must say so
+        # rather than vacuously pass
+        net, states = _entry_states("pingpong")
+        placed = make_mesh2d_layout(2, 4).place(net, states)
+        assert channel_ownership(net, placed) == {}
+        with pytest.raises(AssertionError, match="no in_sig"):
+            assert_channel_ownership(net, placed)
+
+
+class TestRunCacheGeometry:
+    def test_layouts_are_distinct_cached_programs(self):
+        from wittgenstein_tpu.parallel.replica_shard import (
+            _RUN_CACHE,
+            clear_run_cache,
+        )
+
+        net, states = _entry_states("handel")
+        clear_run_cache()
+        ref, ref_stats = sharded_run_stats(net, states, SIM_MS)
+        a = make_mesh2d_layout(2, 4)
+        b = make_mesh2d_layout(4, 2)
+        out_a, stats_a = sharded_run_stats(net, states, SIM_MS, layout=a)
+        out_b, stats_b = sharded_run_stats(net, states, SIM_MS, layout=b)
+        # one entry per geometry: unsharded (None) + (2,4) + (4,2)
+        keys = {k[2] for k in _RUN_CACHE}
+        assert keys == {None, a.geometry(), b.geometry()}
+        _assert_bitwise(out_a, ref)
+        _assert_bitwise(out_b, ref)
+        for k, v in ref_stats.items():
+            np.testing.assert_array_equal(np.asarray(v),
+                                          np.asarray(stats_a[k]))
+            np.testing.assert_array_equal(np.asarray(v),
+                                          np.asarray(stats_b[k]))
+
+    def test_same_layout_geometry_hits_cache(self):
+        from wittgenstein_tpu.parallel.replica_shard import (
+            clear_run_cache,
+            run_cache_info,
+        )
+
+        net, states = _entry_states("handel")
+        clear_run_cache()
+        layout = make_mesh2d_layout(2, 4)
+        sharded_run_stats(net, states, SIM_MS, layout=layout)
+        before = run_cache_info()["hits"]
+        # a FRESH layout object with the same geometry must hit
+        sharded_run_stats(
+            net, states, SIM_MS, layout=make_mesh2d_layout(2, 4)
+        )
+        assert run_cache_info()["hits"] == before + 1

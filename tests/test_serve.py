@@ -681,3 +681,85 @@ class TestDrain:
         sched.stop()
         assert job.state is JobState.DONE, job.error
         assert job.result["digest"] == sched.run_singleton(spec)["digest"]
+
+
+class TestNodeParallelLanes:
+    """ISSUE 16: lanes over 2D sub-meshes — a scheduler built with
+    node_parallel=P gives every lane a (replicas, nodes) group, jobs
+    stay bitwise identical to their singletons, and a lane failure
+    re-binds the 2D-sharded family to a healthy lane WITHOUT costing
+    the healthy lane's own families any recompiles."""
+
+    def test_status_and_lane_meshes(self):
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=4,
+            device_groups=2, node_parallel=2,
+        )
+        assert sched.status()["nodeParallel"] == 2
+        for lane in sched._lanes:
+            assert lane.group.node_parallel == 2
+            assert lane.group.mesh.axis_names == ("replicas", "nodes")
+            assert lane.group.mesh.devices.shape == (2, 2)
+
+    def test_2d_lane_results_bitwise_identical_to_singleton(self):
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=4,
+            device_groups=2, node_parallel=2,
+        )
+        specs = [{**BASE, "seed": i} for i in range(3)]
+        jobs = [sched.submit(s) for s in specs]
+        while sched.drain_once(0):
+            pass
+        for j, s in zip(jobs, specs):
+            assert j.state is JobState.DONE, (s, j.error)
+            assert j.result["digest"] == sched.run_singleton(s)["digest"], s
+
+    def test_failover_rebinds_2d_family_without_recompiling_healthy(self):
+        from wittgenstein_tpu.runtime import LaneFailedError
+
+        sched = BatchScheduler(
+            auto_start=False, max_batch_replicas=4,
+            device_groups=2, node_parallel=2,
+        )
+        a_spec = {**BASE, "seed": 0}
+        b_spec = {"protocol": "PingPong", "params": {"node_ct": 48},
+                  "simMs": 60, "seed": 0}
+        a = sched.submit(a_spec)
+        assert sched.drain_once(0)
+        b = sched.submit(b_spec)
+        assert sched.drain_once(1)
+        assert a.state is JobState.DONE, a.error
+        assert b.state is JobState.DONE, b.error
+        assert sched._family_lane[a.compat] == 0
+        assert sched._family_lane[b.compat] == 1
+
+        # lane 0 dies: its 2D-sharded family re-binds to the healthy lane
+        lane1 = sched._lanes[1]
+        lane1.thread = threading.Thread(target=lambda: time.sleep(2))
+        lane1.thread.start()
+        sched._on_lane_failure(sched._lanes[0], LaneFailedError(0, "test"))
+        assert sched._family_lane[a.compat] == 1
+        assert sched.metrics.lane_rebinds_total >= 1
+        lane1.thread.join()
+
+        # the healthy lane's own family still runs on its compiled
+        # program — a fresh B job costs ZERO new compiles
+        before = run_cache_info()["compiles"]
+        b2_spec = {**b_spec, "seed": 1}
+        b2 = sched.submit(b2_spec)
+        assert sched.drain_once(1)
+        assert b2.state is JobState.DONE, b2.error
+        assert run_cache_info()["compiles"] == before
+
+        # and the re-bound family serves from lane 1, bitwise as ever
+        a2_spec = {**a_spec, "seed": 1}
+        a2 = sched.submit(a2_spec)
+        assert sched.drain_once(1)
+        assert a2.state is JobState.DONE, a2.error
+        assert a2.result["digest"] == sched.run_singleton(a2_spec)["digest"]
+        assert b2.result["digest"] == sched.run_singleton(b2_spec)["digest"]
+        sched.stop()
+
+    def test_invalid_node_parallel_rejected(self):
+        with pytest.raises(ValueError):
+            BatchScheduler(auto_start=False, node_parallel=0)

@@ -1,6 +1,6 @@
 """simlint command line: `python -m wittgenstein_tpu.analysis [opts]`.
 
-Runs up to seven passes and prints findings as `path:line: RULE [sev] msg`
+Runs up to eight passes and prints findings as `path:line: RULE [sev] msg`
 (or JSONL with --format json):
 
   1. AST lint over every wittgenstein_tpu/*.py  (SL1xx/SL2xx)
@@ -10,6 +10,7 @@ Runs up to seven passes and prints findings as `path:line: RULE [sev] msg`
   5. checkpoint completeness                    (SL501)
   6. phase-annotation presence + neutrality     (SL601)
   7. serve scheduler batching contract          (SL801)
+  8. 2D-mesh replicated-leaf audit              (SL1001)
 
 Exit status: 0 when clean; 1 when any ERROR finding (or, with --strict,
 any finding at all) survives suppression; 2 on usage errors.  Passes 3-7
@@ -103,6 +104,9 @@ def run(root: str, skip_contracts: bool = False,
         from .serve_check import check_serve_scheduler
 
         findings += check_serve_scheduler(root=root, names=protocols)
+        from .mesh_check import check_mesh_layout
+
+        findings += check_mesh_layout(root=root, names=protocols)
     return findings
 
 

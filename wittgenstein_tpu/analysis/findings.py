@@ -91,6 +91,14 @@ RULES: Dict[str, str] = {
     "over the dtype's headroom (sentinel slot included), live leaf "
     "dtype diverging from its declaration, or concrete steps producing "
     "values outside [0, declared_max]",
+    # -- 2D-mesh replicated-leaf audit -----------------------------------------
+    "SL1001": "mesh replicated-leaf audit (parallel.mesh2d): a state "
+    "leaf classifies differently single-state vs stacked, a "
+    "protocol-owned proto-dict leaf collides with an engine "
+    "_MESSAGE_STORE_FIELDS exclusion name (silently replicated along "
+    "the node axis, forfeiting its 1/P memory share), or a store-field "
+    "exclusion entry matches no live leaf of any registered protocol "
+    "(stale exemption)",
 }
 
 

@@ -1,0 +1,168 @@
+"""2D-mesh replicated-leaf audit (SL1001).
+
+The composed (replicas, nodes) mesh (parallel.mesh2d) places every
+state leaf by ONE classification rule: node columns shard on the node
+axis, everything else replicates along it, with the engine-owned
+message store / telemetry / fault side-cars excluded BY NAME
+(node_shard._MESSAGE_STORE_FIELDS) because a wheel dimension can
+coincide with n_nodes.  That name-based exclusion is the audit surface:
+it silently mis-places a leaf the day a protocol mints a proto-dict
+field whose path contains an engine store-field name (the substring
+match would REPLICATE a genuinely node-indexed array — correctness
+survives, the 1/P memory win silently dies for that leaf), or the day a
+store field is renamed and its exclusion entry goes stale (exempting
+nothing, while a future field reusing the name inherits the exemption).
+
+SL1001 closes the loop per registered protocol, at the same small
+analysis scale the other dynamic passes use:
+
+- **classification totality + stacked/single agreement** — every leaf
+  of the entry's state classifies identically whether viewed as a
+  single simulation or as a stacked replica batch (a disagreement means
+  the leading-axis offset logic broke for that shape);
+- **proto-dict name collisions** — no protocol-owned leaf (under
+  ``.proto[``) may match a _MESSAGE_STORE_FIELDS exclusion: the
+  side-car names belong to the engine, and a colliding protocol field
+  would be silently replicated along the node axis;
+- **stale exclusions** — every _MESSAGE_STORE_FIELDS entry must still
+  name at least one live leaf across the audited states (checked once
+  over the whole registry sweep, anchored at node_shard.py).
+
+Protocol-level suppression: list "SL1001" in the class's
+SIMLINT_SUPPRESS tuple (same mechanism as the other dynamic rules).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from .contracts import _cpu_jax, _mk, _proto_location
+from .findings import Finding
+
+_MAX_LEAF_REPORTS = 4
+
+
+def check_entry_mesh(entry, root: str = ".", _stale_seen=None) -> List[Finding]:
+    """SL1001 for one registry entry; [] when clean or when the entry
+    opts out of contract checks (standalone engines have no generic
+    SimState to place on the mesh)."""
+    jax = _cpu_jax()
+    if not entry.contract_checks:
+        return []
+
+    from ..parallel.mesh2d import classify_leaf
+    from ..parallel.node_shard import _MESSAGE_STORE_FIELDS
+
+    net, state = entry.factory()
+    path, line = _proto_location(net.protocol)
+    try:
+        path = os.path.relpath(path, root)
+    except ValueError:
+        pass
+    suppress = set(getattr(net.protocol, "SIMLINT_SUPPRESS", ()) or ())
+    if "SL1001" in suppress:
+        return []
+
+    findings: List[Finding] = []
+    n = net.n_nodes
+    flat = list(jax.tree_util.tree_flatten_with_path(state)[0])
+    # plain entries carry empty tele/fault side-cars (zero leaves), so
+    # the audit arms telemetry the way checkpoint_check does: the tele
+    # counter rows must classify as replicated-along-nodes and their
+    # exclusion entries must register as live, not stale
+    if getattr(net, "telemetry", None) is None:
+        from ..telemetry.state import TelemetryConfig
+
+        try:
+            _tnet, tstate = net.with_telemetry(
+                state, TelemetryConfig(snapshots=0)
+            )
+            flat += list(jax.tree_util.tree_flatten_with_path(tstate)[0])
+        except Exception as e:  # noqa: BLE001 — instrumentation failure
+            f = _mk("SL1001", path, line,
+                    f"[{entry.name}] telemetry instrumentation failed "
+                    f"while arming the side-car mesh audit: "
+                    f"{type(e).__name__}: {e}", suppress)
+            if f:
+                findings.append(f)
+
+    disagree, collide = [], []
+    for kp, leaf in flat:
+        key = jax.tree_util.keystr(kp)
+        shape = tuple(getattr(leaf, "shape", ()))
+        if _stale_seen is not None:
+            for f in _MESSAGE_STORE_FIELDS:
+                if f in key:
+                    _stale_seen.add(f)
+        single = classify_leaf(key, shape, n, stacked=False)
+        stacked = classify_leaf(key, (2,) + shape, n, stacked=True)
+        # the single-state classes map 1:1 onto the stacked ones:
+        # node-column stays node-column, replicated becomes replica-row
+        want = "node-column" if single == "node-column" else "replica-row"
+        if stacked != want:
+            disagree.append((key, single, stacked))
+        if key.startswith(".proto[") and any(
+            f in key for f in _MESSAGE_STORE_FIELDS
+        ):
+            collide.append(key)
+
+    for key, single, stacked in disagree[:_MAX_LEAF_REPORTS]:
+        f = _mk("SL1001", path, line,
+                f"[{entry.name}] leaf {key!r} classifies as {single!r} "
+                f"single-state but {stacked!r} stacked — the mesh2d "
+                "leading-axis offset logic mis-places this shape",
+                suppress)
+        if f:
+            findings.append(f)
+    for key in collide[:_MAX_LEAF_REPORTS]:
+        f = _mk("SL1001", path, line,
+                f"[{entry.name}] protocol-owned leaf {key!r} collides "
+                "with an engine _MESSAGE_STORE_FIELDS name — mesh2d "
+                "would silently REPLICATE it along the node axis, "
+                "forfeiting its 1/P share of the memory budget; rename "
+                "the protocol field", suppress)
+        if f:
+            findings.append(f)
+    return findings
+
+
+def check_mesh_layout(root: str = ".", names=None) -> List[Finding]:
+    """SL1001 over every registered batched protocol (or the named
+    subset), plus the registry-wide stale-exclusion sweep."""
+    from ..core.registries import registry_batched_protocols
+    from ..parallel import node_shard
+    from ..parallel.node_shard import _MESSAGE_STORE_FIELDS
+    from .findings import Severity
+
+    findings: List[Finding] = []
+    seen: set = set()
+    audited = False
+    for entry in registry_batched_protocols.entries():
+        if names and entry.name not in names:
+            continue
+        if entry.contract_checks:
+            audited = True
+        findings.extend(check_entry_mesh(entry, root=root, _stale_seen=seen))
+    # stale exclusions only mean something over the FULL sweep: a name
+    # subset legitimately misses side-car fields of unselected entries
+    if audited and not names:
+        ns_path = node_shard.__file__
+        try:
+            ns_path = os.path.relpath(ns_path, root)
+        except ValueError:
+            pass
+        for field in _MESSAGE_STORE_FIELDS:
+            if field in seen:
+                continue
+            findings.append(Finding(
+                rule="SL1001", path=ns_path, line=1,
+                message=(
+                    f"_MESSAGE_STORE_FIELDS entry {field!r} matched no "
+                    "leaf of any registered protocol's state — a stale "
+                    "exclusion exempts nothing today and silently "
+                    "exempts a future leaf that reuses the name"
+                ),
+                severity=Severity.ERROR,
+            ))
+    return findings

@@ -306,7 +306,24 @@ def load_state(template: Any, src: str, verify: bool = True) -> Any:
     checked against its manifest crc32 — computed on the STORED bytes,
     before any cast — so silent bit-rot surfaces as
     CheckpointCorruptError naming the leaf.
+
+    Mesh portability: checkpoints store plain host bytes (np.asarray
+    gathers every shard), so the file itself carries no mesh — a
+    checkpoint written under a 1D replica mesh restores bitwise into a
+    2D (replicas, nodes) mesh and back.  Resharding happens HERE, on
+    load: when a template leaf is committed to a NamedSharding, the
+    restored leaf is device_put onto that same sharding; an unsharded
+    template restores exactly as before.  Geometry conflicts stay loud:
+    shape/dtype mismatches raise CheckpointShapeError regardless of
+    either side's mesh.
     """
+
+    def _restore(arr, tmpl):
+        sharding = getattr(tmpl, "sharding", None)
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            return jax.device_put(jax.numpy.asarray(arr), sharding)
+        return jax.numpy.asarray(arr)
+
     with _open_npz(src) as data:
         found_layout = str(data[LAYOUT_KEY]) if LAYOUT_KEY in data else None
         if found_layout is not None:
@@ -336,7 +353,7 @@ def load_state(template: Any, src: str, verify: bool = True) -> Any:
             key = _path_str(path)
             if key not in data:
                 if key in EPHEMERAL_LEAVES:
-                    leaves.append(jax.numpy.asarray(np.asarray(leaf)))
+                    leaves.append(_restore(np.asarray(leaf), leaf))
                     continue
                 raise CheckpointMissingLeafError(
                     f"checkpoint {src} is missing leaf {key!r}"
@@ -370,7 +387,7 @@ def load_state(template: Any, src: str, verify: bool = True) -> Any:
                         )
             if arr.dtype != want.dtype:
                 arr = _coerce_dtype(src, key, arr, want.dtype)
-            leaves.append(jax.numpy.asarray(arr))
+            leaves.append(_restore(arr, leaf))
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(template), leaves
         )

@@ -20,6 +20,14 @@ multi-host (DCN) — the mesh is the only thing that changes.
 """
 
 from .device_groups import DeviceGroup, make_device_groups
+from .mesh2d import (
+    MeshLayout,
+    assert_channel_ownership,
+    channel_ownership,
+    classify_leaf,
+    make_mesh2d,
+    make_mesh2d_layout,
+)
 from .node_shard import (
     enable_node_sharding,
     node_shard_bytes,
@@ -35,7 +43,13 @@ from .replica_shard import (
 
 __all__ = [
     "DeviceGroup",
+    "MeshLayout",
+    "assert_channel_ownership",
+    "channel_ownership",
+    "classify_leaf",
     "make_device_groups",
+    "make_mesh2d",
+    "make_mesh2d_layout",
     "clear_run_cache",
     "enable_node_sharding",
     "node_shard_bytes",

@@ -6,18 +6,28 @@ compatibility family owns the machine.  A serving fleet has K families
 in flight — with one global mesh they *serialize* through one worker
 even though each batch only needs 1/G of the devices.  A DeviceGroup is
 the unit of that partition: a contiguous slice of ``jax.devices()``
-wrapped in its own one-axis ``Mesh``, so each scheduler lane places its
-batches onto its own devices and up to G families execute concurrently
+wrapped in its own ``Mesh``, so each scheduler lane places its batches
+onto its own devices and up to G families execute concurrently
 ("wave packing").
 
+A lane's mesh can itself be 2D: with ``node_parallel=P`` the group's
+devices fold into a ``(len(devices)//P, P)`` (replicas, nodes) sub-mesh
+(parallel.mesh2d), so one lane runs replica rows whose node state is
+split P-ways — the serving-fleet face of the composed 2D mesh.  With
+the default ``node_parallel=1`` the group is the flat one-axis lane it
+always was, bit-for-bit.
+
 Placement discipline: ``place`` shards the stacked state across the
-group's devices when the replica count divides the group size, else it
-commits the whole batch to the group's first device — either way the
-arrays are COMMITTED to this group, so XLA never migrates a lane's work
-onto another lane's devices mid-wave.  Row bytes are placement-
-independent (replica rows are elementwise lane-independent under vmap),
-which is why wave packing can promise bitwise identity with the
-single-worker schedule.
+group's devices when the replica count divides the group's replica
+rows, else it commits the whole batch to the group's first device —
+either way the arrays are COMMITTED to this group, so XLA never
+migrates a lane's work onto another lane's devices mid-wave.  Node-axis
+placement additionally needs the engine (to classify node columns), so
+``place`` takes an optional ``net``; without it a 2D group still
+replica-shards correctly (node columns replicated along the node axis).
+Row bytes are placement-independent (replica rows are elementwise
+lane-independent under vmap), which is why wave packing can promise
+bitwise identity with the single-worker schedule.
 
 Validated on CPU via --xla_force_host_platform_device_count, same as
 every other mesh path in parallel/.
@@ -35,28 +45,70 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 @dataclasses.dataclass(frozen=True)
 class DeviceGroup:
     """One lane's slice of the machine: index + devices + its own
-    replica-axis mesh."""
+    replica-axis mesh — 2D (replicas, nodes) when ``node_parallel`` > 1."""
 
     index: int
     devices: tuple
+    node_parallel: int = 1
+
+    def __post_init__(self):
+        if self.node_parallel < 1:
+            raise ValueError(
+                f"node_parallel must be >= 1, got {self.node_parallel}"
+            )
+        if len(self.devices) % self.node_parallel != 0:
+            raise ValueError(
+                f"node_parallel={self.node_parallel} must divide the "
+                f"group's device count ({len(self.devices)})"
+            )
+
+    @property
+    def replica_parallel(self) -> int:
+        return len(self.devices) // self.node_parallel
 
     @property
     def mesh(self) -> Mesh:
         import numpy as np
 
+        if self.node_parallel > 1:
+            return Mesh(
+                np.array(self.devices).reshape(
+                    self.replica_parallel, self.node_parallel
+                ),
+                ("replicas", "nodes"),
+            )
         return Mesh(np.array(self.devices), ("replicas",))
+
+    def layout(self):
+        """The group's mesh as a mesh2d.MeshLayout — node axis active
+        only when the group actually folds one in."""
+        from .mesh2d import MeshLayout
+
+        return MeshLayout(
+            self.mesh,
+            replica_axis="replicas",
+            node_axis="nodes" if self.node_parallel > 1 else None,
+        )
 
     def sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P("replicas"))
 
-    def place(self, states):
+    def place(self, states, net=None):
         """Commit a stacked state pytree (leading replica axis) to this
-        group: replica-sharded when the leading axis divides the group
-        size, whole-batch on the first device otherwise (correct either
-        way; the sharded form is the throughput case)."""
+        group: replica-sharded when the leading axis divides the group's
+        replica rows, whole-batch on the first device otherwise (correct
+        either way; the sharded form is the throughput case).  With
+        ``net`` and a 2D group, node columns are additionally sharded on
+        the group's node axis (the full mesh2d placement); without
+        ``net`` they stay replicated along it — still correct, still
+        committed to this lane's devices."""
         leaves = jax.tree_util.tree_leaves(states)
         n_rows = leaves[0].shape[0] if leaves and leaves[0].shape else 0
-        if n_rows and n_rows % len(self.devices) == 0:
+        if n_rows and n_rows % self.replica_parallel == 0:
+            if net is not None and self.node_parallel > 1:
+                lay = self.layout()
+                if net.n_nodes % self.node_parallel == 0:
+                    return lay.place(net, states)
             sharding = self.sharding()
             return jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, sharding), states
@@ -67,16 +119,25 @@ class DeviceGroup:
         )
 
     def label(self) -> str:
-        return f"group{self.index}[{len(self.devices)}dev]"
+        mesh_tag = (
+            f"{self.replica_parallel}x{self.node_parallel}"
+            if self.node_parallel > 1
+            else f"{len(self.devices)}dev"
+        )
+        return f"group{self.index}[{mesh_tag}]"
 
 
 def make_device_groups(
-    n_groups: int, devices: Optional[Sequence] = None
+    n_groups: int,
+    devices: Optional[Sequence] = None,
+    node_parallel: int = 1,
 ) -> List[DeviceGroup]:
     """Partition ``devices`` (default: all visible) into ``n_groups``
-    contiguous equal slices.  Group count must divide the device count —
-    an uneven fleet would give lanes different compiled-program
-    geometries and silently break the one-compile-per-family contract."""
+    contiguous equal slices, each folded into a (replicas, nodes)
+    sub-mesh when ``node_parallel`` > 1.  Group count must divide the
+    device count and node_parallel must divide the per-group size — an
+    uneven fleet would give lanes different compiled-program geometries
+    and silently break the one-compile-per-family contract."""
     if n_groups < 1:
         raise ValueError(f"n_groups must be >= 1, got {n_groups}")
     devs = list(devices) if devices is not None else list(jax.devices())
@@ -93,7 +154,14 @@ def make_device_groups(
             "program geometries"
         )
     per = len(devs) // n_groups
+    if node_parallel < 1 or per % node_parallel != 0:
+        raise ValueError(
+            f"node_parallel={node_parallel} must divide the per-group "
+            f"device count ({per})"
+        )
     return [
-        DeviceGroup(g, tuple(devs[g * per : (g + 1) * per]))
+        DeviceGroup(
+            g, tuple(devs[g * per : (g + 1) * per]), node_parallel
+        )
         for g in range(n_groups)
     ]

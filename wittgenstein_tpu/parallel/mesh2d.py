@@ -1,0 +1,285 @@
+"""2D device mesh: replica × node sharding composed into ONE program.
+
+The replica axis (replica_shard) scales the number of simulations; the
+node axis (node_shard) scales one simulation past a device's memory.
+Each was proven separately; the paper's feasibility budget (BUDGET.json:
+94.6 MiB/replica, R=83/chip at 4096 nodes) assumes they COMPOSE — a
+v5e-8 runs R replica rows each of whose node state is split over P_node
+chips.  This module is that composition: a single
+``Mesh((p_replica, p_node))`` over which ``run_ms_batched`` is
+partitioned on both axes at once.
+
+Axis semantics (the full table lives in docs/parallel.md):
+
+  * axis 0 ``replicas`` — every leaf of a stacked state has a leading
+    [R] replica dim (replicate_state broadcasts scalars to [R] too), so
+    EVERY leaf is sharded on axis 0.  Replica rows are independent under
+    vmap, so this axis never needs a collective until the stats
+    reduction.
+  * axis 1 ``nodes`` — leaves whose post-replica dim is node-indexed
+    ([R, N, ...]) are additionally sharded on axis 1.  The engine-owned
+    message store (time wheel [W, B], overflow lane [V]), telemetry and
+    fault side-cars are arrival-/mtype-indexed, NOT node-indexed — they
+    are excluded BY NAME (node_shard._MESSAGE_STORE_FIELDS) and
+    replicated along ``nodes`` even when a wheel dim coincides with
+    n_nodes.  Per-replica scalars ([R]: time, seed, send_ctr, dropped,
+    msg_head) are explicitly ``P("replicas")`` — replicated along
+    ``nodes`` by construction, never left to sharding inference.
+
+Bit-identity: everything in the tick is integer or elementwise-float
+math, so GSPMD partitioning cannot reorder a reduction — the 2D-mesh
+run is bitwise identical to the unsharded singleton (asserted by
+tests/test_mesh2d.py and scripts/mesh2d_smoke.py, same bar as
+flat-vs-wheel and fused-vs-unfused).  The 1/P channel-ownership
+invariant (__graft_entry__.py dryrun) generalizes: on a (P_r, P_n)
+mesh every node-column channel array holds exactly
+total_bytes / (P_r * P_n) per device.
+
+Layout is a CONSTRUCTOR-TIME decision: a frozen ``MeshLayout`` names
+the mesh and which axes are in play (either may be None, expressing the
+legacy 1D layouts), and the run cache (replica_shard._CachedRun) and
+durable compile store key on ``MeshLayout.geometry()`` so a (2,4) and a
+(4,2) program over the same 8 devices can never collide.
+
+Provable on CPU with XLA_FLAGS=--xla_force_host_platform_device_count=8,
+same as every other mesh path in parallel/.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .node_shard import _MESSAGE_STORE_FIELDS
+
+REPLICA_AXIS = "replicas"
+NODE_AXIS = "nodes"
+
+
+def make_mesh2d(
+    p_replica: int,
+    p_node: int,
+    devices: Optional[Sequence] = None,
+    replica_axis: str = REPLICA_AXIS,
+    node_axis: str = NODE_AXIS,
+) -> Mesh:
+    """A (p_replica, p_node) mesh over ``devices`` (default: all
+    visible).  The product must equal the device count — a partial mesh
+    would leave devices idle while claiming the full fleet's geometry."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if p_replica < 1 or p_node < 1:
+        raise ValueError(
+            f"mesh axes must be >= 1, got ({p_replica}, {p_node})"
+        )
+    if p_replica * p_node != len(devs):
+        raise ValueError(
+            f"mesh ({p_replica}, {p_node}) needs {p_replica * p_node} "
+            f"devices, have {len(devs)}"
+        )
+    return Mesh(
+        np.array(devs).reshape(p_replica, p_node),
+        (replica_axis, node_axis),
+    )
+
+
+def classify_leaf(key: str, shape: tuple, n_nodes: int,
+                  stacked: bool = True) -> str:
+    """Which sharding class a state leaf belongs to: ``"node-column"``
+    (shard on the node axis), ``"replica-row"`` (stacked leaf with no
+    node dim — sharded on replicas, replicated along nodes) or
+    ``"replicated"`` (single-state leaf with no node dim).  ``key`` is
+    the jax keystr path; the message-store / telemetry / fault side-car
+    exclusion is BY NAME, exactly node_shard's rule, because a wheel
+    dim can coincide with n_nodes without being node-indexed.  Shared
+    with the simlint mesh audit (analysis.mesh_check) so the static
+    classification and the runtime placement can never drift."""
+    if any(f in key for f in _MESSAGE_STORE_FIELDS):
+        return "replica-row" if stacked else "replicated"
+    off = 1 if stacked else 0
+    if len(shape) > off and shape[off] == n_nodes:
+        return "node-column"
+    return "replica-row" if stacked else "replicated"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshLayout:
+    """A constructor-time sharding decision: which mesh, and which of
+    its axes carry the replica rows / node columns.  Either axis may be
+    None — ``MeshLayout(mesh, replica_axis="replicas", node_axis=None)``
+    is the legacy 1D replica layout, ``(None, "nodes")`` the legacy 1D
+    node layout — so every entry point takes ONE layout argument instead
+    of choosing between shard functions."""
+
+    mesh: Mesh
+    replica_axis: Optional[str] = REPLICA_AXIS
+    node_axis: Optional[str] = NODE_AXIS
+
+    def __post_init__(self):
+        if self.replica_axis is None and self.node_axis is None:
+            raise ValueError("MeshLayout needs at least one active axis")
+        for ax in (self.replica_axis, self.node_axis):
+            if ax is not None and ax not in self.mesh.axis_names:
+                raise ValueError(
+                    f"axis {ax!r} not in mesh axes {self.mesh.axis_names}"
+                )
+
+    # -- geometry -------------------------------------------------------
+
+    @property
+    def p_replica(self) -> int:
+        return (
+            self.mesh.shape[self.replica_axis]
+            if self.replica_axis is not None
+            else 1
+        )
+
+    @property
+    def p_node(self) -> int:
+        return (
+            self.mesh.shape[self.node_axis]
+            if self.node_axis is not None
+            else 1
+        )
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.size
+
+    def geometry(self) -> tuple:
+        """Restart-stable identity of this layout: active axis names and
+        sizes in mesh order, plus total device count.  This is what the
+        run cache and the durable compile store key on — (2,4) and (4,2)
+        over the same 8 devices yield distinct geometries."""
+        axes = tuple(
+            (name, int(self.mesh.shape[name]))
+            for name in self.mesh.axis_names
+        )
+        return (
+            "mesh-layout/v1",
+            axes,
+            self.replica_axis,
+            self.node_axis,
+            int(self.mesh.size),
+        )
+
+    def describe(self) -> str:
+        parts = []
+        if self.replica_axis is not None:
+            parts.append(f"{self.replica_axis}={self.p_replica}")
+        if self.node_axis is not None:
+            parts.append(f"{self.node_axis}={self.p_node}")
+        return f"mesh[{','.join(parts)}]"
+
+    # -- placement ------------------------------------------------------
+
+    def spec_for(self, key: str, shape: tuple, n_nodes: int) -> P:
+        """The PartitionSpec for one leaf.  Stacked states (replica axis
+        active) shard every leaf on the replica axis; node columns pick
+        up the node axis on their post-replica dim."""
+        stacked = self.replica_axis is not None
+        cls = classify_leaf(key, shape, n_nodes, stacked=stacked)
+        if stacked:
+            if cls == "node-column" and self.node_axis is not None:
+                return P(self.replica_axis, self.node_axis)
+            return P(self.replica_axis)
+        if cls == "node-column" and self.node_axis is not None:
+            return P(self.node_axis)
+        return P()
+
+    def validate(self, net, states) -> None:
+        """Divisibility preflight: replica rows must divide p_replica and
+        n_nodes must divide p_node, else device_put would fail leaf by
+        leaf with an opaque XLA error."""
+        if self.replica_axis is not None:
+            leaves = jax.tree_util.tree_leaves(states)
+            rows = leaves[0].shape[0] if leaves and leaves[0].shape else 0
+            if rows == 0 or rows % self.p_replica != 0:
+                raise ValueError(
+                    f"replica rows ({rows}) must be a positive multiple "
+                    f"of the mesh replica axis ({self.p_replica})"
+                )
+        if self.node_axis is not None and net.n_nodes % self.p_node != 0:
+            raise ValueError(
+                f"n_nodes ({net.n_nodes}) must divide evenly over the "
+                f"mesh node axis ({self.p_node})"
+            )
+
+    def place(self, net, states):
+        """Commit a state pytree to this layout.  With an active replica
+        axis the pytree is a stacked [R, ...] state; without one it is a
+        single simulation's state (the legacy node_shard shape)."""
+        self.validate(net, states)
+        n = net.n_nodes
+
+        def put(path, a):
+            a = jnp.asarray(a)
+            key = jax.tree_util.keystr(path)
+            spec = self.spec_for(key, tuple(a.shape), n)
+            return jax.device_put(a, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map_with_path(put, states)
+
+
+def make_mesh2d_layout(
+    p_replica: int, p_node: int, devices: Optional[Sequence] = None
+) -> MeshLayout:
+    """The common construction: a fresh (p_replica, p_node) mesh wrapped
+    in a both-axes-active layout."""
+    return MeshLayout(
+        make_mesh2d(p_replica, p_node, devices),
+        replica_axis=REPLICA_AXIS,
+        node_axis=NODE_AXIS,
+    )
+
+
+# ---------------------------------------------------------------------------
+# ownership audit: the dryrun 1/P invariant, generalized to 2D
+
+
+def channel_ownership(net, states) -> dict:
+    """{leaf_path: (per_device_bytes, total_bytes)} for every
+    aggregation-channel array (``in_sig*``) of a placed state, measured
+    from the ACTUAL addressable shards — what each device really holds,
+    not what the annotation promised."""
+    out = {}
+
+    def visit(path, a):
+        key = jax.tree_util.keystr(path)
+        if "in_sig" not in key or not hasattr(a, "addressable_shards"):
+            return
+        out[key] = (
+            max(s.data.nbytes for s in a.addressable_shards),
+            a.nbytes,
+        )
+
+    jax.tree_util.tree_map_with_path(visit, states)
+    return out
+
+
+def assert_channel_ownership(net, states, n_devices: Optional[int] = None):
+    """The __graft_entry__ dryrun invariant on a 2D mesh: every channel
+    array's per-device shard is exactly total_bytes / n_devices.  On a
+    (P_r, P_n) mesh both axes shard the channel ([R, N, ...] rows on
+    replicas, node columns on nodes), so the divisor is the FULL device
+    count.  Raises AssertionError naming the first offending leaf."""
+    if n_devices is None:
+        n_devices = jax.device_count()
+    owned = channel_ownership(net, states)
+    if not owned:
+        raise AssertionError(
+            "no in_sig channel arrays found — ownership unverifiable"
+        )
+    for key, (per_dev, total) in owned.items():
+        expect = total // n_devices
+        if per_dev != expect:
+            raise AssertionError(
+                f"channel ownership violated for {key}: per-device "
+                f"{per_dev} B != total {total} B / {n_devices} devices "
+                f"({expect} B)"
+            )
+    return owned

@@ -117,27 +117,25 @@ def shard_state_by_node(net, state, mesh: Mesh, axis: str = "nodes"):
     array (leading dim == n_nodes) sharded over `axis` and everything
     else (scalars, the time-wheel message store, static tables)
     replicated.  Store fields are excluded BY NAME — the wheel's [W, B]
-    shape can coincide with n_nodes without being node-indexed."""
-    n = net.n_nodes
-    row_sharding = NamedSharding(mesh, P(axis))
-    rep_sharding = NamedSharding(mesh, P())
+    shape can coincide with n_nodes without being node-indexed.
 
-    def put(path, a):
-        a = jnp.asarray(a)
-        key = jax.tree_util.keystr(path)
-        if any(f in key for f in _MESSAGE_STORE_FIELDS):
-            return jax.device_put(a, rep_sharding)
-        if a.ndim >= 1 and a.shape[0] == n:
-            return jax.device_put(a, row_sharding)
-        return jax.device_put(a, rep_sharding)
+    Thin wrapper over mesh2d.MeshLayout with only the node axis active:
+    the legacy 1D entry point and the 2D composition share one
+    classification rule by construction."""
+    from .mesh2d import MeshLayout
 
-    return jax.tree_util.tree_map_with_path(put, state)
+    layout = MeshLayout(mesh, replica_axis=None, node_axis=axis)
+    return layout.place(net, state)
 
 
-def run_ms_node_sharded(net, state, ms: int):
+def run_ms_node_sharded(net, state, ms: int, layout=None):
     """Advance a node-sharded simulation `ms` milliseconds: the engine's
     own compiled program, partitioned by XLA over the state's shardings.
-    Call with the output of shard_state_by_node."""
+    Call with the output of shard_state_by_node (or pass a
+    mesh2d.MeshLayout to place `state` here — sharding as a layout
+    argument rather than a separate entry point)."""
+    if layout is not None:
+        state = layout.place(net, state)
     return net.run_ms(state, ms)
 
 

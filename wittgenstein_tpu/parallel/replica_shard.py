@@ -78,23 +78,36 @@ _COUNTERS = {
 
 
 class _CachedRun:
-    """The cached entry for one (net.cache_key(), sim_ms): a callable
-    with jit semantics whose compiles are explicit.  Per input geometry
-    (leaf shapes/dtypes/shardings) it lowers and compiles ONCE, records
-    the compile wall-clock and the normalized cost/memory analyses, then
-    dispatches to the compiled executable."""
+    """The cached entry for one (net.cache_key(), sim_ms, layout
+    geometry): a callable with jit semantics whose compiles are
+    explicit.  Per input geometry (leaf shapes/dtypes/shardings) it
+    lowers and compiles ONCE, records the compile wall-clock and the
+    normalized cost/memory analyses, then dispatches to the compiled
+    executable.
 
-    def __init__(self, net, sim_ms: int, key: tuple):
+    Sharding is a CONSTRUCTOR-TIME layout decision: when a
+    mesh2d.MeshLayout is given, every call places the incoming states
+    onto that layout before dispatch, and the layout's geometry is part
+    of both the in-process cache key and the durable-store key — a
+    (2,4) and a (4,2) program over the same devices never collide."""
+
+    def __init__(self, net, sim_ms: int, key: tuple, layout=None):
         self.key = key
+        self.net = net
+        self.layout = layout
         self.protocol = type(net.protocol).__name__
         self.sim_ms = int(sim_ms)
         # restart-stable identity for the durable compile store; engines
-        # predating stable_cache_key simply never use the store
+        # predating stable_cache_key simply never use the store.  The
+        # layout geometry rides inside the digest so the store cannot
+        # serve a program compiled for a different mesh shape.
         stable = getattr(net, "stable_cache_key", None)
+        geometry = layout.geometry() if layout is not None else None
         self.stable_key = (
             "run/"
             + hashlib.blake2b(
-                repr((stable(), self.sim_ms)).encode(), digest_size=12
+                repr((stable(), self.sim_ms, geometry)).encode(),
+                digest_size=12,
             ).hexdigest()
             if callable(stable)
             else None
@@ -145,18 +158,30 @@ class _CachedRun:
     def _store_key(self, states) -> "str | None":
         if self.stable_key is None:
             return None
-        from ..runtime.compile_store import geometry_signature
+        from ..runtime.compile_store import (
+            geometry_signature,
+            mesh_geometry_signature,
+        )
 
-        return f"{self.stable_key}/geom-{geometry_signature(states)}"
+        return (
+            f"{self.stable_key}"
+            f"/mesh-{mesh_geometry_signature(states)}"
+            f"/geom-{geometry_signature(states)}"
+        )
 
     def __call__(self, states):
+        if self.layout is not None:
+            states = self.layout.place(self.net, states)
         sig = self._signature(states)
         compiled = self._programs.get(sig)
         if compiled is None:
             with self._compile_lock:
                 compiled = self._programs.get(sig)
                 if compiled is None:
-                    from ..runtime.compile_store import get_compile_store
+                    from ..runtime.compile_store import (
+                        get_compile_store,
+                        mesh_geometry_signature,
+                    )
 
                     store = get_compile_store()
                     skey = (
@@ -164,8 +189,13 @@ class _CachedRun:
                         if store is not None
                         else None
                     )
+                    mesh_sig = (
+                        mesh_geometry_signature(states)
+                        if skey is not None
+                        else None
+                    )
                     if skey is not None:
-                        compiled = store.get(skey)
+                        compiled = store.get(skey, mesh_geometry=mesh_sig)
                     if compiled is not None:
                         # adopted from the durable store: no lowering
                         # happened, so "compiles" must NOT tick (the
@@ -190,7 +220,9 @@ class _CachedRun:
                             ),
                             **compiled_cost_summary(compiled, dt),
                         }
-                        if skey is not None and store.put(skey, compiled):
+                        if skey is not None and store.put(
+                            skey, compiled, mesh_geometry=mesh_sig
+                        ):
                             _COUNTERS["store_puts"] += 1
                     self._programs[sig] = compiled
         return compiled(states)
@@ -228,11 +260,17 @@ def run_cache_metrics() -> dict:
     }
 
 
-def _run_and_reduce(net, sim_ms: int):
-    """One cached entry per (net.cache_key(), sim_ms): repeated calls
-    with an equivalent network hit the cache instead of re-tracing the
-    full simulation."""
-    key = (net.cache_key(), int(sim_ms))
+def _run_and_reduce(net, sim_ms: int, layout=None):
+    """One cached entry per (net.cache_key(), sim_ms, layout geometry):
+    repeated calls with an equivalent network AND layout hit the cache
+    instead of re-tracing the full simulation.  The layout geometry is
+    part of the key — the same network on a (2,4) vs (4,2) mesh is two
+    distinct programs."""
+    key = (
+        net.cache_key(),
+        int(sim_ms),
+        layout.geometry() if layout is not None else None,
+    )
     with _CACHE_LOCK:
         fn = _RUN_CACHE.get(key)
         if fn is not None:
@@ -241,7 +279,7 @@ def _run_and_reduce(net, sim_ms: int):
             return fn
 
         _COUNTERS["misses"] += 1
-        fn = _CachedRun(net, sim_ms, key)
+        fn = _CachedRun(net, sim_ms, key, layout=layout)
         _RUN_CACHE[key] = fn
         while len(_RUN_CACHE) > _RUN_CACHE_MAX:
             _RUN_CACHE.popitem(last=False)
@@ -249,8 +287,12 @@ def _run_and_reduce(net, sim_ms: int):
         return fn
 
 
-def sharded_run_stats(net, states, sim_ms: int) -> Tuple[jax.Array, dict]:
-    """Run the batched simulation on whatever sharding `states` carries and
-    reduce done/traffic statistics across every device in the same program.
-    Returns (final_states, stats dict of scalars)."""
-    return _run_and_reduce(net, sim_ms)(states)
+def sharded_run_stats(net, states, sim_ms: int, layout=None
+                      ) -> Tuple[jax.Array, dict]:
+    """Run the batched simulation and reduce done/traffic statistics
+    across every device in the same program.  Without a layout the
+    states run on whatever sharding they carry (the legacy contract);
+    with a mesh2d.MeshLayout the cached program places them onto that
+    layout first and is keyed on its geometry.  Returns (final_states,
+    stats dict of scalars)."""
+    return _run_and_reduce(net, sim_ms, layout=layout)(states)

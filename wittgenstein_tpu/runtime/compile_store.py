@@ -117,10 +117,14 @@ class CompileStore:
 
     # -- write ----------------------------------------------------------
 
-    def put(self, stable_key: str, compiled: Any) -> bool:
+    def put(self, stable_key: str, compiled: Any,
+            mesh_geometry: Optional[str] = None) -> bool:
         """Serialize one compiled executable under ``stable_key``.
-        Returns False (counted as an error) when the executable refuses
-        to serialize or the filesystem refuses the write."""
+        ``mesh_geometry`` (mesh_geometry_signature of the program's
+        inputs) is recorded in the manifest so a stale-by-mesh entry is
+        diagnosable, not just a miss.  Returns False (counted as an
+        error) when the executable refuses to serialize or the
+        filesystem refuses the write."""
         from jax.experimental import serialize_executable
 
         try:
@@ -135,6 +139,8 @@ class CompileStore:
             "payload_bytes": len(payload),
             **_environment(),
         }
+        if mesh_geometry is not None:
+            manifest["mesh_geometry"] = mesh_geometry
         man_path, bin_path = self._paths(stable_key)
         pid = os.getpid()
         try:
@@ -162,11 +168,15 @@ class CompileStore:
 
     # -- read -----------------------------------------------------------
 
-    def get(self, stable_key: str) -> Optional[Any]:
+    def get(self, stable_key: str,
+            mesh_geometry: Optional[str] = None) -> Optional[Any]:
         """Load the executable stored under ``stable_key``, or None.
         None means "compile fresh": missing entry (miss), environment
         mismatch (stale) or undecodable entry (corrupt) all degrade the
-        same way and are counted separately."""
+        same way and are counted separately.  When ``mesh_geometry`` is
+        given, an entry recorded under a different mesh shape — same
+        device COUNT, different (axis, size) factorization, e.g. (2,4)
+        vs (4,2) of 8 devices — is stale, never served."""
         man_path, bin_path = self._paths(stable_key)
         try:
             with open(man_path, "rb") as f:
@@ -187,6 +197,12 @@ class CompileStore:
             return None
         env = _environment()
         if any(manifest.get(k) != v for k, v in env.items()):
+            _count("stale")
+            return None
+        # symmetric: an entry recorded under a mesh shape is stale for a
+        # caller that declares none, and vice versa — "I don't know the
+        # mesh" must never adopt a partitioned executable
+        if manifest.get("mesh_geometry") != mesh_geometry:
             _count("stale")
             return None
         try:
@@ -310,6 +326,36 @@ def geometry_signature(args: Any) -> str:
     ).hexdigest()
 
 
+def mesh_geometry_signature(args: Any) -> str:
+    """Canonical tag of the mesh SHAPES an input pytree is committed to:
+    every distinct (axis_names × axis_sizes) among the leaves' mesh-
+    backed shardings, sorted, or ``"unmeshed"`` when no leaf carries
+    one.  This is the key component _environment()'s ``device_count``
+    cannot express: a (2,4) and a (4,2) mesh of the same 8 devices have
+    equal device counts but partition a program differently, so their
+    executables must never share a store entry."""
+    import jax
+
+    shapes = set()
+    for leaf in jax.tree_util.tree_leaves(args):
+        sharding = getattr(leaf, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        if mesh is None:
+            continue
+        try:
+            shapes.add(
+                ",".join(
+                    f"{name}={int(mesh.shape[name])}"
+                    for name in mesh.axis_names
+                )
+            )
+        except (AttributeError, TypeError, KeyError):
+            continue
+    if not shapes:
+        return "unmeshed"
+    return ";".join(sorted(shapes))
+
+
 class DurableJit:
     """jit semantics with store-backed compiles: per input geometry,
     try the compile store, else ``lower().compile()`` and publish.  The
@@ -342,14 +388,18 @@ class DurableJit:
                 compiled = self._programs.get(sig)
                 if compiled is None:
                     store = self._resolve_store()
-                    key = f"{self.stable_key}/geom-{sig}"
+                    mesh_sig = mesh_geometry_signature(args)
+                    key = (
+                        f"{self.stable_key}/mesh-{mesh_sig}/geom-{sig}"
+                    )
                     if store is not None:
-                        compiled = store.get(key)
+                        compiled = store.get(key, mesh_geometry=mesh_sig)
                     if compiled is None:
                         compiled = self._jit.lower(*args).compile()
                         self.compiles += 1
                         if store is not None:
-                            store.put(key, compiled)
+                            store.put(key, compiled,
+                                      mesh_geometry=mesh_sig)
                     self._programs[sig] = compiled
         return compiled(*args)
 

@@ -264,6 +264,7 @@ class BatchScheduler:
         auto_start: bool = True,
         recorder: Optional[FlightRecorder] = None,
         device_groups: int = 1,
+        node_parallel: int = 1,
         horizon_quantum_ms: int = 0,
         binding_ttl_s: float = 300.0,
         salvage: Optional[SalvagePolicy] = None,
@@ -307,7 +308,11 @@ class BatchScheduler:
             raise ValueError(
                 f"device_groups must be >= 1, got {device_groups}"
             )
-        if device_groups == 1:
+        if node_parallel < 1:
+            raise ValueError(
+                f"node_parallel must be >= 1, got {node_parallel}"
+            )
+        if device_groups == 1 and node_parallel == 1:
             # no explicit placement: bit-for-bit the legacy scheduler
             # (and no re-placement cost for the common case)
             self._lanes = [_Lane(0, None)]
@@ -315,9 +320,13 @@ class BatchScheduler:
             from ..parallel.device_groups import make_device_groups
 
             self._lanes = [
-                _Lane(g.index, g) for g in make_device_groups(device_groups)
+                _Lane(g.index, g)
+                for g in make_device_groups(
+                    device_groups, node_parallel=node_parallel
+                )
             ]
         self.device_groups = len(self._lanes)
+        self.node_parallel = node_parallel
         self._dispatch_lock = threading.Lock()
         self._family_lane: Dict[str, int] = {}
         self._active_dispatches = 0
@@ -875,8 +884,9 @@ class BatchScheduler:
         if lane is not None and lane.group is not None:
             # commit the batch to this lane's devices: wave packing's
             # concurrency comes from different lanes running on
-            # disjoint device groups
-            stacked = lane.group.place(stacked)
+            # disjoint device groups; with a 2D lane the engine hands
+            # place() the node-column classification
+            stacked = lane.group.place(stacked, net=fam.net)
         t0 = time.monotonic()
         try:
             self._chaos_check(fam, jobs)
@@ -920,7 +930,7 @@ class BatchScheduler:
         # mode costs ONE extra compile per family, not one per slice
         cached = _run_and_reduce(fam.net, unit)
         placement = (
-            lane.group.place
+            (lambda s, _g=lane.group, _n=fam.net: _g.place(s, net=_n))
             if lane is not None and lane.group is not None
             else None
         )
@@ -1188,7 +1198,7 @@ class BatchScheduler:
         def run(subset: List[Job]) -> None:
             stacked = self._pack(fam, subset)
             if lane is not None and lane.group is not None:
-                stacked = lane.group.place(stacked)
+                stacked = lane.group.place(stacked, net=fam.net)
             self._chaos_check(fam, subset)
             out, _stats = sharded_run_stats(fam.net, stacked, fam.sim_ms)
             self._finalize(fam, subset, out)
@@ -1216,7 +1226,7 @@ class BatchScheduler:
             ]
             stacked = self._pack(fam, subset)
             if lane is not None and lane.group is not None:
-                stacked = lane.group.place(stacked)
+                stacked = lane.group.place(stacked, net=fam.net)
             self._chaos_check(fam, subset)
             cached = _run_and_reduce(fam.net, unit)
             rows = {}
@@ -1649,6 +1659,7 @@ class BatchScheduler:
             "maxBatchReplicas": self.max_batch_replicas,
             "retryAfterS": self.retry_after_s(),
             "deviceGroups": self.device_groups,
+            "nodeParallel": self.node_parallel,
             "horizonQuantumMs": self.horizon_quantum_ms,
             "lanes": [lane.describe() for lane in self._lanes],
             "waveWidthMax": self.metrics.wave_width_max,
