@@ -23,6 +23,9 @@ rewrites re-anchor it).  A >10% drop between consecutive rounds is
 reported in the trajectory (``regressions``) but only fails the gate
 when the newer round ALSO breaks the floor: a drop the floor file
 absorbs is a documented regression, a drop below the floor is not.
+The gate also refuses a committed BENCH_SERVE.json that failed or
+whose ``alerts`` block shows ANY SLO alert (the serve benchmark is
+fault-free by construction — an alert there is a regression or noise).
 
 Usage:
   python scripts/bench_trend.py [-o trend.json]
@@ -257,6 +260,16 @@ def check(trend: dict) -> list:
             "BENCH_SERVE.json records a failed serve benchmark: "
             + "; ".join(serve.get("failures", ["unknown"]))[:300]
         )
+    # mission control: the serve benchmark runs fault-free, so ANY SLO
+    # alert in its committed record means either a service regression
+    # or alert noise — both gate failures, even if the record claims ok
+    if serve is not None:
+        alerts = serve.get("alerts") or {}
+        if alerts.get("total"):
+            problems.append(
+                "BENCH_SERVE.json records SLO alerts during a fault-free "
+                f"benchmark: {alerts.get('by_slo')}"
+            )
     # same discipline for the 2D-mesh ladder: a committed record whose
     # rungs broke bit-identity or channel ownership must not pass CI
     mesh = trend.get("mesh")

@@ -17,7 +17,10 @@ Phase A — fleet chaos against a LIVE scheduler (worker lanes running):
   identical to its fault-free ``run_singleton`` reference; the lane
   restarted at least once; the flight recorder holds the whole story
   (lane-failed, lane-restart, salvage-start/run, quarantine,
-  salvage-done).
+  salvage-done); AND mission control saw it all — the quarantine fired
+  an error-kind-rate SLO alert naming the poison job's run_id, the
+  lane kill fired lane-restart-rate, both as typed slo-alert events
+  with witt_obs_alerts_total incremented.
 
 Phase B — checkpoint corruption against a deterministic scheduler
 (auto_start=False, driven by drain_once):
@@ -159,6 +162,43 @@ def phase_a(out_dir: str, failures: list) -> dict:
                  "salvage-run", "quarantine", "salvage-done"):
         if want not in kinds:
             failures.append(f"phase A: recorder missing {want!r} event")
+    # mission control: each injected fault must fire its matching SLO
+    # alert — the zero-objective burn rates are exactly the "any error
+    # in the window" tripwires chaos exists to prove out.  Evaluation
+    # is pull-driven, so evaluate() here IS the page.
+    sched.slo.evaluate()
+    alert_counts = sched.slo.alert_counts()["by_slo"]
+    if not alert_counts.get("error-kind-rate"):
+        failures.append(
+            "phase A: poison quarantine fired no error-kind-rate alert"
+        )
+    if not alert_counts.get("lane-restart-rate"):
+        failures.append(
+            "phase A: lane kill fired no lane-restart-rate alert"
+        )
+    active = {
+        a["slo"]: a
+        for a in sched.slo.status(evaluate=False)["activeAlerts"]
+    }
+    err_ctx = (active.get("error-kind-rate") or {}).get("ctx") or {}
+    if err_ctx.get("run_id") != poison.run_id:
+        failures.append(
+            "phase A: error-kind-rate alert names run "
+            f"{err_ctx.get('run_id')!r}, expected the poison job's "
+            f"{poison.run_id!r}"
+        )
+    if "slo-alert" not in {e["kind"] for e in recorder.events()}:
+        failures.append("phase A: recorder missing 'slo-alert' event")
+    from wittgenstein_tpu.telemetry.export import PromText
+
+    prom = PromText()
+    sched.add_prometheus(prom)
+    prom_text = prom.render()
+    for slo in ("error-kind-rate", "lane-restart-rate"):
+        if f'witt_obs_alerts_total{{slo="{slo}"' not in prom_text:
+            failures.append(
+                f"phase A: witt_obs_alerts_total missing the {slo} family"
+            )
     store1 = compile_store_counters()
     health = sched.health()
     summary = {
@@ -170,6 +210,7 @@ def phase_a(out_dir: str, failures: list) -> dict:
         "storePayloadsVandalized": vandalized,
         "storeCorrupt": store1["corrupt"] - store0["corrupt"],
         "errorKinds": health["errorKinds"],
+        "sloAlerts": alert_counts,
     }
     recorder.dump(os.path.join(out_dir, "flight_recorder_dump.jsonl"))
     return summary

@@ -6,18 +6,24 @@ and the atomic ``flight_recorder_dump.jsonl`` the supervisor drops
 beside the checkpoints on any typed failure.  This tool turns them back
 into something a human (or CI) can read:
 
-  timeline DUMP [DUMP...] [--run RUN_ID]
+  timeline DUMP [DUMP...] [--run RUN_ID] [--format text|json]
       per-run, time-ordered text timeline: admission, packing, every
       chunk with tick HWMs, retries, watchdog fires, kills, resumes —
       multiple files (e.g. a SIGKILLed victim's and its resumer's)
       merge into one timeline because they share one run_id.
+      --format json emits the merged, sorted events as JSONL instead.
   trace DUMP [DUMP...] -o trace.json [--run RUN_ID]
       the same events as a merged Chrome trace (chunk-start/chunk-end
       pairs become complete spans, everything else instants) — opens in
       chrome://tracing / Perfetto next to SpanTracer output and carries
       the same run_id args.
-  runs DUMP [DUMP...]
+  runs DUMP [DUMP...] [--format json|text]
       the run_ids present, with event counts and time span (discovery).
+
+DUMP may also be a committed bench record — BENCH_SERVE.json or
+BENCH_MESH.json — whose rungs/failures/alert counts are synthesized
+into events under a bench:<basename> run_id, so one timeline can put a
+benchmark result next to the live recorder dumps around it.
   collect OUT_DIR [ROOT...]
       CI forensics: sweep ROOTs (default: $WITT_OBS_DIR and the serve
       checkpoint temp dirs) for flight-recorder files and the newest
@@ -51,8 +57,73 @@ _SUMMARY_FIELDS = (
 )
 
 
+def _bench_events(path: str, rec: dict):
+    """Synthesize timeline events from a committed bench record
+    (witt-bench-serve/v1 or witt-bench-mesh/v1 shape).  Bench records
+    carry no per-event timestamps, so everything lands at the file's
+    mtime under a ``bench:<basename>`` run_id — enough for the
+    timeline/runs views to show the record next to live recorder
+    dumps."""
+    try:
+        ts = os.path.getmtime(path)
+    except OSError:
+        ts = 0.0
+    rid = f"bench:{os.path.basename(path)}"
+    evs = []
+
+    def ev(kind, **fields):
+        evs.append({"ts": ts, "kind": kind, "run_id": rid, **fields})
+
+    schema = rec.get("schema", "")
+    if "rungs" in rec:  # mesh ladder record
+        for r in rec.get("rungs") or []:
+            ev("bench-mesh-rung", **{
+                k: r.get(k) for k in (
+                    "p_replica", "p_node", "nodes", "replicas",
+                    "sims_per_sec", "run_s", "bit_identical",
+                ) if k in r
+            })
+        best = rec.get("best")
+        if best:
+            ev("bench-mesh-best",
+               p_replica=best.get("p_replica"),
+               p_node=best.get("p_node"),
+               sims_per_sec=best.get("sims_per_sec"))
+        return evs
+    # serve fleet record
+    ev("bench-serve", schema=schema, ok=rec.get("ok"),
+       speedup=rec.get("speedup"),
+       bitwise=rec.get("bitwiseIdentical"),
+       alerts=(rec.get("alerts") or {}).get("total"),
+       **{f"resilience_{k}": v
+          for k, v in (rec.get("resilience") or {}).items()})
+    for f in rec.get("failures") or []:
+        ev("bench-failure", message=f if isinstance(f, str) else None,
+           **(f if isinstance(f, dict) else {}))
+    return evs
+
+
 def load_events(paths, run_id=None):
-    evs = read_events(list(paths))
+    """Events from recorder JSONL dumps AND committed bench records:
+    a path whose whole content parses as ONE JSON object (and is not
+    itself a single recorder event) is treated as a bench record
+    (BENCH_SERVE.json / BENCH_MESH.json) and synthesized into events."""
+    evs = []
+    jsonl = []
+    for p in paths:
+        rec = None
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            rec = None
+        if isinstance(rec, dict) and not ("kind" in rec and "ts" in rec):
+            evs.extend(_bench_events(p, rec))
+        else:
+            jsonl.append(p)
+    if jsonl:
+        evs.extend(read_events(jsonl))
+    evs.sort(key=lambda e: e.get("ts", 0.0))
     if run_id:
         evs = [e for e in evs if e.get("run_id") == run_id]
     return evs
@@ -285,10 +356,19 @@ def main(argv=None) -> int:
 
     for name in ("timeline", "trace", "runs"):
         sp = sub.add_parser(name)
-        sp.add_argument("dumps", nargs="+", help="flight-recorder JSONL files")
+        sp.add_argument("dumps", nargs="+",
+                        help="flight-recorder JSONL files and/or committed "
+                        "bench records (BENCH_SERVE.json, BENCH_MESH.json)")
         sp.add_argument("--run", help="restrict to one run_id")
         if name == "trace":
             sp.add_argument("-o", "--out", required=True)
+        else:
+            sp.add_argument(
+                "--format", choices=("text", "json"),
+                default="text" if name == "timeline" else "json",
+                help="timeline: text lines or the merged events as JSONL; "
+                "runs: JSON summary (default) or text lines",
+            )
 
     cp = sub.add_parser("collect")
     cp.add_argument("out_dir")
@@ -307,10 +387,24 @@ def main(argv=None) -> int:
 
     events = load_events(args.dumps, run_id=args.run)
     if args.cmd == "timeline":
-        sys.stdout.write(render_timeline(events))
+        if args.format == "json":
+            for e in events:
+                print(json.dumps(e, sort_keys=True))
+        else:
+            sys.stdout.write(render_timeline(events))
         return 0
     if args.cmd == "runs":
-        print(json.dumps(run_ids(events), indent=2, sort_keys=True))
+        summary = run_ids(events)
+        if args.format == "text":
+            for rid, s in summary.items():
+                span = s["t1"] - s["t0"]
+                kinds = ",".join(
+                    f"{k}:{n}" for k, n in sorted(s["kinds"].items())
+                )
+                print(f"{rid}  events={s['events']} span={span:.3f}s "
+                      f"{kinds}")
+        else:
+            print(json.dumps(summary, indent=2, sort_keys=True))
         return 0
     # trace
     from wittgenstein_tpu.telemetry.trace import validate_chrome_trace

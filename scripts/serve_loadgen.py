@@ -203,7 +203,12 @@ def _fleet_run(specs, device_groups: int) -> dict:
     queue_wait = sorted(j.started_at - j.submitted_at for j in jobs)
     latency = sorted(j.finished_at - j.submitted_at for j in jobs)
     m = sched.metrics
+    # mission control: evaluate the SLO engine once at the end of the
+    # run (pull model) so the record carries the alert counts — a
+    # fault-free benchmark must show zero
+    sched.slo.evaluate()
     return {
+        "alerts": sched.slo.alert_counts(),
         "deviceGroups": device_groups,
         "jobs": len(jobs),
         "wallS": round(wall_s, 4),
@@ -287,7 +292,24 @@ def fleet_bench(device_groups: int, per_family: int,
             f"(quarantined={resilience['quarantined']}, "
             f"laneRestarts={resilience['laneRestarts']})"
         )
+    # ... and zero SLO alerts: any alert during a fault-free benchmark
+    # is either a real service regression or alert noise, and both must
+    # fail the run (bench_trend --check re-asserts this on the
+    # committed record)
+    by_slo: dict = {}
+    for run in (serial, wave):
+        for slo, n in run["alerts"]["by_slo"].items():
+            by_slo[slo] = by_slo.get(slo, 0) + n
+        run.pop("alerts")
+    alerts = {"total": sum(by_slo.values()),
+              "by_slo": dict(sorted(by_slo.items()))}
+    if alerts["total"]:
+        failures.append(
+            f"SLO alerts fired during a fault-free benchmark: "
+            f"{alerts['by_slo']}"
+        )
     return {
+        "alerts": alerts,
         "schema": "witt-bench-serve/v1",
         "ok": not failures,
         "config": {
@@ -430,9 +452,21 @@ def main() -> int:
         'witt_serve_time_to_first_result_seconds{quantile="0.5"}',
         "witt_serve_compile_cache_hit_ratio",
         "witt_run_cache_misses_total",
+        'witt_obs_slo_firing{slo="error-kind-rate"}',
+        'witt_obs_slo_firing{slo="queue-wait-p95"}',
     ):
         if family not in gauges:
             failures.append(f"/metrics is missing {family}")
+    # mission control: this phase injects no faults, so it must end
+    # with ZERO SLO alerts — an alert here is either a real service
+    # regression or alert noise, both failures
+    ws.jobs.slo.evaluate()
+    alerts = ws.jobs.slo.alert_counts()
+    if alerts["total"]:
+        failures.append(
+            f"SLO alerts fired during fault-free loadgen: "
+            f"{alerts['by_slo']}"
+        )
     httpd.shutdown()
     ws.jobs.stop()
 
@@ -456,6 +490,7 @@ def main() -> int:
             "p99": quantile(lat, 0.99),
         },
         "runCacheDelta": {"misses": new_misses, "compiles": new_compiles},
+        "alerts": alerts,
         "failures": failures,
     }
     with open(os.path.join(args.out_dir, "slo_report.jsonl"), "a") as f:

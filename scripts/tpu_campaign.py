@@ -81,6 +81,57 @@ def log(rec: dict) -> None:
     print(json.dumps(rec), flush=True)
 
 
+# mission control: the campaign feeds each rung's sims/s into an
+# in-process timeseries and evaluates the BENCH_FLOOR.json floor SLO
+# (obs/slo.py) — a breach lands in the ledger as an slo_alert event
+# (witt_watch --campaign surfaces it) and as a typed flight-recorder
+# event.  Lazily armed on the first rung; [engine] boxed for the
+# child's single thread.
+_campaign_slo = [None]
+
+
+def _observe_rung(rec: dict) -> None:
+    """Best-effort by contract: monitoring never kills a campaign."""
+    try:
+        from wittgenstein_tpu.obs import (
+            SLOEngine,
+            TimeSeriesStore,
+            default_serve_specs,
+            get_recorder,
+        )
+
+        if _campaign_slo[0] is None:
+            specs = [
+                s for s in default_serve_specs()
+                if s.name == "sims-per-sec-floor"
+            ]
+            if not specs:
+                return  # no committed BENCH_FLOOR.json: nothing to arm
+            _campaign_slo[0] = SLOEngine(
+                TimeSeriesStore(), specs, recorder=get_recorder()
+            )
+        engine = _campaign_slo[0]
+        engine.store.observe(
+            "campaign.sims_per_sec", float(rec["sims_per_sec"]),
+            ctx={"nodes": rec.get("nodes"),
+                 "replicas": rec.get("replicas")},
+        )
+        before = engine.alert_counts()["total"]
+        rows = engine.evaluate()
+        if engine.alert_counts()["total"] > before:
+            for row in rows:
+                if row["state"] == "firing":
+                    log({
+                        "event": "slo_alert", "slo": row["slo"],
+                        "severity": row["severity"],
+                        "measured": row["measured_fast"],
+                        "objective": row["objective"],
+                        "burn_slow": row["burn_slow"],
+                    })
+    except Exception as e:  # noqa: BLE001 — monitoring is best-effort
+        log({"event": "slo_eval_error", "error": f"{type(e).__name__}: {e}"})
+
+
 def _events() -> list:
     evs = []
     if os.path.exists(OUT):
@@ -286,6 +337,7 @@ def campaign() -> None:
             "counters": counters(net, out),
         }
         log(rec)
+        _observe_rung(rec)
         results.append(rec)
         # the rung is durably logged: drop its checkpoints so a later
         # campaign with a cleaned jsonl can never resume a finished pass

@@ -1,21 +1,23 @@
 """simlint command line: `python -m wittgenstein_tpu.analysis [opts]`.
 
-Runs up to eight passes and prints findings as `path:line: RULE [sev] msg`
+Runs up to nine passes and prints findings as `path:line: RULE [sev] msg`
 (or JSONL with --format json):
 
   1. AST lint over every wittgenstein_tpu/*.py  (SL1xx/SL2xx)
   2. registry/test coverage meta-rule           (SL301)
-  3. abstract-eval contract checks              (SL401-SL404)
-  4. beat RNG audit                             (SL405)
-  5. checkpoint completeness                    (SL501)
-  6. phase-annotation presence + neutrality     (SL601)
-  7. serve scheduler batching contract          (SL801)
-  8. 2D-mesh replicated-leaf audit              (SL1001)
+  3. SLO alert catalog audit                    (SL1101)
+  4. abstract-eval contract checks              (SL401-SL404)
+  5. beat RNG audit                             (SL405)
+  6. checkpoint completeness                    (SL501)
+  7. phase-annotation presence + neutrality     (SL601)
+  8. serve scheduler batching contract          (SL801)
+  9. 2D-mesh replicated-leaf audit              (SL1001)
 
 Exit status: 0 when clean; 1 when any ERROR finding (or, with --strict,
-any finding at all) survives suppression; 2 on usage errors.  Passes 3-7
+any finding at all) survives suppression; 2 on usage errors.  Passes 4-8
 build every registered protocol and trace real kernels, so they take tens
-of seconds — `--skip-contracts` runs just the fast text-level passes.
+of seconds — `--skip-contracts` runs just the fast text-level passes
+(1-3; no JAX import).
 """
 
 from __future__ import annotations
@@ -72,6 +74,9 @@ def run(root: str, skip_contracts: bool = False,
     # bad fixtures for simlint's own test suite
     findings = list(lint_package(os.path.join(root, "wittgenstein_tpu")))
     findings += check_registry_coverage(root)
+    from .slo_check import check_slo_catalog
+
+    findings += check_slo_catalog(root)
     findings = [
         dataclasses.replace(f, path=_rel(f.path, root)) for f in findings
     ]

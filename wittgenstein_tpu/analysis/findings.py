@@ -99,6 +99,12 @@ RULES: Dict[str, str] = {
     "the node axis, forfeiting its 1/P memory share), or a store-field "
     "exclusion entry matches no live leaf of any registered protocol "
     "(stale exemption)",
+    # -- SLO alert catalog audit ------------------------------------------------
+    "SL1101": "SLO alert catalog audit (obs.slo): an alert-capable call "
+    "site — fire_violation()/alert() first argument, SLOSpec(name=...), "
+    "or an slo=... keyword — names a string literal missing from "
+    "REGISTERED_SLOS, so a dashboard keyed on the catalog would "
+    "silently miss its alerts",
 }
 
 

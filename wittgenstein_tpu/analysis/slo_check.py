@@ -1,0 +1,129 @@
+"""simlint SL1101: the SLO alert catalog audit.
+
+Mission control's promise is that a dashboard keyed on the registered
+SLO names (obs.slo.REGISTERED_SLOS) sees EVERY alert the codebase can
+emit.  That promise breaks silently: a new invariant check that fires
+``fire_violation("wheel-headroom")`` under a name nobody registered
+still alerts at runtime — into a counter label no dashboard row
+matches.  (fire_violation raises on unknown names at runtime, but only
+when that path actually executes; SLOSpec validates at construction,
+but sentinel-style direct violations are strings until fired.)
+
+This pass closes the gap statically: it parses every module under
+``wittgenstein_tpu/`` and ``scripts/`` and audits each alert-capable
+call site whose SLO name is a string literal —
+
+  - ``fire_violation("...")`` / ``_alert("...")`` / ``alert("...")``
+    first arguments (the sentinel's emission chain),
+  - ``SLOSpec(name="...")`` constructions,
+  - ``slo="..."`` keyword arguments on any call (recorder events,
+    engine internals)
+
+— against REGISTERED_SLOS.  A literal outside the catalog is an ERROR
+anchored at the call site.  Dynamic names (variables) are left to the
+runtime guards.  Pure text/AST: no JAX import, so the pass runs under
+``--skip-contracts`` too.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List
+
+from .findings import Finding, Severity, apply_suppressions
+
+#: call-ee names whose FIRST positional string argument is an SLO name
+_NAME_ARG_CALLEES = ("fire_violation", "_alert", "alert")
+
+#: files that define the catalog / validators themselves (docstrings and
+#: error messages there mention hypothetical names)
+_EXEMPT_SUFFIXES = (
+    os.path.join("analysis", "slo_check.py"),
+)
+
+
+def _callee_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _literal(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _audit_source(path: str, source: str, registered: set) -> List[Finding]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    findings: List[Finding] = []
+
+    def bad(node, name: str, where: str) -> None:
+        findings.append(Finding(
+            "SL1101", path, node.lineno,
+            f"{where} names SLO {name!r}, which is not in "
+            "obs.slo.REGISTERED_SLOS — register it (and its dashboard "
+            "row) before emitting under it",
+            Severity.ERROR,
+        ))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _callee_name(node)
+        if callee in _NAME_ARG_CALLEES and node.args:
+            name = _literal(node.args[0])
+            if name is not None and name not in registered:
+                bad(node, name, f"{callee}() call")
+        if callee == "SLOSpec":
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    name = _literal(kw.value)
+                    if name is not None and name not in registered:
+                        bad(node, name, "SLOSpec(name=...)")
+        for kw in node.keywords:
+            if kw.arg == "slo":
+                name = _literal(kw.value)
+                if name is not None and name not in registered:
+                    bad(node, name, f"{callee}(slo=...) keyword")
+    return apply_suppressions(findings, source)
+
+
+def _py_files(root: str) -> Iterable[str]:
+    for sub in ("wittgenstein_tpu", "scripts"):
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [
+                d for d in dirnames
+                if d != "__pycache__" and not d.startswith(".")
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def check_slo_catalog(root: str) -> List[Finding]:
+    """SL1101 over the package + scripts trees.  See module docstring."""
+    from ..obs.slo import REGISTERED_SLOS
+
+    registered = set(REGISTERED_SLOS)
+    findings: List[Finding] = []
+    for path in _py_files(root):
+        if path.endswith(_EXEMPT_SUFFIXES):
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        findings += _audit_source(path, source, registered)
+    return findings

@@ -11,6 +11,7 @@ it armed.  See docs/observability.md for the id-join map.
 
 from .attribution import batch_attribution, replica_rows
 from .context import TraceContext, mint_context, new_run_id
+from .monitor import InvariantSentinel, load_capacity_table
 from .recorder import (
     DUMP_BASENAME,
     ENV_DIR,
@@ -21,6 +22,13 @@ from .recorder import (
     read_events,
     reset_default_recorder,
 )
+from .slo import (
+    REGISTERED_SLOS,
+    SLOEngine,
+    SLOSpec,
+    default_serve_specs,
+)
+from .timeseries import TimeSeriesStore
 
 __all__ = [
     "TraceContext",
@@ -33,6 +41,13 @@ __all__ = [
     "failure_dump_paths",
     "batch_attribution",
     "replica_rows",
+    "TimeSeriesStore",
+    "SLOSpec",
+    "SLOEngine",
+    "REGISTERED_SLOS",
+    "default_serve_specs",
+    "InvariantSentinel",
+    "load_capacity_table",
     "LIVE_BASENAME",
     "DUMP_BASENAME",
     "ENV_DIR",

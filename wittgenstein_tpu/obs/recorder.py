@@ -72,6 +72,12 @@ DEFAULT_CAPACITY = 4096
 #     salvage-done     bisection verdict (salvaged/quarantined/failed)
 #     drain-start      graceful drain engaged (admission now refuses)
 #     drain-end        undrain — admission + claiming resume
+#   mission control (obs.slo / obs.monitor):
+#     slo-alert            a burn-rate SLO started firing (slo, severity,
+#                          burn_fast/burn_slow, measured, victim ids)
+#     slo-resolved         that SLO returned to ok
+#     invariant-violation  the runtime sentinel caught a broken
+#                          invariant (slo names it; replica/mtype named)
 KNOWN_KINDS = (
     "admission",
     "admission-rejected",
@@ -97,6 +103,9 @@ KNOWN_KINDS = (
     "salvage-done",
     "drain-start",
     "drain-end",
+    "slo-alert",
+    "slo-resolved",
+    "invariant-violation",
 )
 
 

@@ -186,6 +186,8 @@ class Supervisor:
         ctx: Optional[TraceContext] = None,
         recorder: Optional[FlightRecorder] = None,
         placement: Optional[Callable[[Any], Any]] = None,
+        timeseries: Any = None,
+        sentinel: Any = None,
     ):
         if n_chunks < 1:
             raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
@@ -231,6 +233,14 @@ class Supervisor:
         # jnp.asarray materialization, never in degraded mode (CPU
         # fallback overrides any group placement)
         self.placement = placement
+        # mission control (optional): an obs.TimeSeriesStore fed at the
+        # per-chunk sync boundary (history the SLO engine queries) and
+        # an obs.InvariantSentinel checked there too.  Both read
+        # already-synced host state only — arming them is bitwise-
+        # neutral, and neither may ever fail the run (_observe_chunk
+        # swallows; sentinel.check never raises by contract)
+        self.timeseries = timeseries
+        self.sentinel = sentinel
         self._wd_worker: Optional[WatchdogWorker] = None
         self._first_call_done = False
         self._degraded = False
@@ -326,6 +336,35 @@ class Supervisor:
         except (TypeError, ValueError, AttributeError):
             return {}
 
+    def _observe_chunk(self, state: Any, chunk: int, dt: float,
+                       hwms: dict) -> None:
+        """Mission-control hook at the per-chunk sync boundary: feed
+        the timeseries history and run the invariant sentinel.  The
+        state here is the same synced, host-readable one _tick_hwms
+        just read.  Monitoring must never fail the run it watches, so
+        everything is swallowed."""
+        ctx = (
+            self.ctx.child(chunk_seq=chunk) if self.ctx is not None else None
+        )
+        if self.timeseries is not None:
+            try:
+                self.timeseries.observe(
+                    "supervisor.chunk_seconds", dt, ctx=ctx
+                )
+                for key in ("wheel_fill_hwm", "ovf_hwm"):
+                    if key in hwms:
+                        self.timeseries.observe(
+                            f"supervisor.{key}", float(hwms[key]), ctx=ctx
+                        )
+            except Exception:  # noqa: BLE001 — monitoring is best-effort
+                pass
+        if self.sentinel is not None:
+            self.sentinel.check(
+                state, ctx=ctx, chunk=chunk,
+                members=self.run_meta.get("members"),
+                capacity=self.run_meta.get("capacity"),
+            )
+
     # -- resume ---------------------------------------------------------
 
     @property
@@ -395,6 +434,13 @@ class Supervisor:
             elif self.ctx.run_id != saved_run_id:
                 self.ctx = self.ctx.child(run_id=saved_run_id)
         prior = list(meta.get("chunk_seconds", []))
+        if self.timeseries is not None:
+            try:
+                # metric history survives kill+resume the same way the
+                # run_id does: the manifest is the authority on the past
+                self.timeseries.restore(meta.get("timeseries"))
+            except Exception:  # noqa: BLE001 — monitoring is best-effort
+                pass
         return self._place(self._snapshot(state)), step, step, prior
 
     def _save(self, state: Any, step: int, times_all: List[float]) -> None:
@@ -407,6 +453,11 @@ class Supervisor:
             "chunk_seconds": [round(t, 4) for t in times_all],
             "degraded": self._degraded,
         }
+        if self.timeseries is not None:
+            try:
+                meta["timeseries"] = self.timeseries.snapshot()
+            except Exception:  # noqa: BLE001 — monitoring is best-effort
+                pass
         if self.ctx is not None:
             # trace ids into the manifest meta (checkpoint.save_state
             # surfaces them as manifest["trace"]) — the join key a
@@ -494,11 +545,13 @@ class Supervisor:
                     t1 = time.perf_counter()
                     state = self._run_chunk(state)
                     dt = time.perf_counter() - t1
+                    hwms = self._tick_hwms(state)
                     self._record(
                         "chunk-end", chunk=i, seconds=round(dt, 4),
                         degraded=self._degraded or None,
-                        **self._tick_hwms(state),
+                        **hwms,
                     )
+                    self._observe_chunk(state, i, dt, hwms)
                     if self.tracer is not None:
                         self.tracer.add_span(
                             "chunk", self.tracer.now_us() - dt * 1e6, dt * 1e6,

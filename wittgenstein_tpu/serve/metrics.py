@@ -30,6 +30,8 @@ import threading
 from collections import deque
 from typing import List, Optional
 
+from ..obs.timeseries import TimeSeriesStore
+
 
 def quantile(values: List[float], q: float) -> float:
     """Nearest-rank quantile of a non-empty list (0 for empty)."""
@@ -53,6 +55,10 @@ class ServeMetrics:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # the in-process metric history the SLO burn-rate engine reads:
+        # every observation below also lands here as a windowed sample
+        # (series named serve.*, see obs/timeseries.py)
+        self.timeseries = TimeSeriesStore()
         self.jobs_submitted = 0
         self.jobs_completed = 0
         self.jobs_failed = 0
@@ -115,6 +121,29 @@ class ServeMetrics:
                     self._recent_runs.append((run_id, tenant, lat))
             if job.first_result_at and job.submitted_at:
                 self._ttfr_s.append(job.first_result_at - job.submitted_at)
+        ids = self._job_ids(job)
+        if job.started_at and job.submitted_at:
+            self.timeseries.observe(
+                "serve.queue_wait_s", job.started_at - job.submitted_at,
+                ctx=ids,
+            )
+        if job.state in (JobState.FAILED, JobState.QUARANTINED):
+            # the victim's ids ride the sample, so a burn-rate alert off
+            # this series names the run that tripped it
+            self.timeseries.inc("serve.errors_total", ctx=ids)
+
+    @staticmethod
+    def _job_ids(job) -> Optional[dict]:
+        run_id = getattr(job, "run_id", None)
+        if not run_id:
+            return None
+        return {
+            "run_id": run_id,
+            "job_id": getattr(job, "id", None),
+            "tenant_id": (
+                job.spec.tenant if getattr(job, "spec", None) else None
+            ),
+        }
 
     def observe_tenant(self, tenant: str, job_attrib: Optional[dict]) -> None:
         """Fold one completed job's attribution slice into its tenant's
@@ -161,7 +190,14 @@ class ServeMetrics:
         with self._lock:
             if job.first_result_at is None:
                 job.first_result_at = time.monotonic()
-                self._ttfr_s.append(job.first_result_at - job.submitted_at)
+                ttfr = job.first_result_at - job.submitted_at
+                self._ttfr_s.append(ttfr)
+            else:
+                ttfr = None
+        if ttfr is not None:
+            self.timeseries.observe(
+                "serve.ttfr_s", ttfr, ctx=self._job_ids(job)
+            )
 
     def observe_wave(self, lane: int, width: int) -> None:
         """One dispatch started on ``lane`` while ``width`` lanes were
@@ -184,13 +220,15 @@ class ServeMetrics:
 
     # -- fleet resilience ----------------------------------------------
 
-    def observe_lane_failure(self) -> None:
+    def observe_lane_failure(self, ctx=None) -> None:
         with self._lock:
             self.lane_failures_total += 1
+        self.timeseries.inc("serve.lane_failures_total", ctx=ctx)
 
-    def observe_lane_restart(self) -> None:
+    def observe_lane_restart(self, ctx=None) -> None:
         with self._lock:
             self.lane_restarts_total += 1
+        self.timeseries.inc("serve.lane_restarts_total", ctx=ctx)
 
     def observe_rebind(self, n: int = 1) -> None:
         """``n`` sticky family bindings moved off a failed lane."""

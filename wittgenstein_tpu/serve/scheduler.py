@@ -84,9 +84,13 @@ from typing import Callable, Dict, List, Optional
 
 from ..obs import (
     FlightRecorder,
+    InvariantSentinel,
+    SLOEngine,
     TraceContext,
     batch_attribution,
+    default_serve_specs,
     get_recorder,
+    load_capacity_table,
     mint_context,
 )
 from ..runtime.errors import (
@@ -195,6 +199,10 @@ class _Lane:
         self.fail_streak = 0
         self.kill_requested = False
         self.abandoned = False
+        # trace ids of the batch this lane last dispatched: when the
+        # worker thread dies, the lane-failure alert names the victim
+        # run instead of an anonymous lane index
+        self.last_ctx: Optional[TraceContext] = None
 
     def alive(self) -> bool:
         return self.thread is not None and self.thread.is_alive()
@@ -358,6 +366,20 @@ class BatchScheduler:
         # isolate it.  run_singleton never calls it: reference results
         # stay fault-free.
         self.chaos_injector: Optional[Callable] = None
+        # mission control: burn-rate SLOs over the metrics history
+        # (obs/slo.py).  Evaluation is pull-driven — health(),
+        # slo_status(), and /metrics each evaluate, so any poller keeps
+        # the alert state fresh; alerts edge-trigger typed
+        # flight-recorder events under the victim sample's run_id.
+        self.slo = SLOEngine(
+            self.metrics.timeseries,
+            default_serve_specs(),
+            recorder=self.recorder,
+        )
+        # runtime invariant sentinel input: the CAPACITY.json sizing
+        # promises, loaded once (a fresh sentinel per chunked batch
+        # keeps the per-invariant alert latch per-run)
+        self._capacity_table = load_capacity_table()
 
     # -- admission -----------------------------------------------------
 
@@ -847,6 +869,8 @@ class BatchScheduler:
         # the pack event records the join batch run_id <-> member job
         # run_ids, so obs_query can walk from any job to its chunks
         batch_ctx = mint_context("batch")
+        if lane is not None:
+            lane.last_ctx = batch_ctx
         self.recorder.record(
             "pack", ctx=batch_ctx, batch_id=batch_id,
             compat=live[0].compat, family_digest=fam.digest,
@@ -934,6 +958,15 @@ class BatchScheduler:
             if lane is not None and lane.group is not None
             else None
         )
+        # a fresh sentinel per batch: the per-invariant alert latch is
+        # per-run, and its violations alert through the scheduler's SLO
+        # engine (typed event + witt_obs_alerts_total) naming this
+        # batch's run_id
+        sentinel = InvariantSentinel(
+            net=fam.net,
+            capacity_table=self._capacity_table,
+            engine=self.slo,
+        )
         sup = Supervisor(
             lambda s: cached(s)[0],
             stacked,
@@ -946,11 +979,14 @@ class BatchScheduler:
             ctx=ctx,
             recorder=self.recorder,
             placement=placement,
+            timeseries=self.metrics.timeseries,
+            sentinel=sentinel,
             # graceful drain: an in-flight slice stops at its next
             # chunk boundary (checkpoint on disk), batch stays parked
             should_stop=self._draining.is_set,
             run_meta={
                 "batch_id": batch_id,
+                "capacity": self.max_batch_replicas,
                 "members": [
                     {"job_id": j.id, "run_id": j.run_id,
                      "tenant": j.spec.tenant}
@@ -1424,9 +1460,10 @@ class BatchScheduler:
         then spawn the replacement with a crash-loop backoff."""
         kind = classify(exc)
         lane.fail_streak += 1
-        self.metrics.observe_lane_failure()
+        victim = lane.last_ctx
+        self.metrics.observe_lane_failure(ctx=victim)
         self.recorder.record(
-            "lane-failed", lane=lane.index, error_kind=kind,
+            "lane-failed", ctx=victim, lane=lane.index, error_kind=kind,
             error=f"{type(exc).__name__}: {exc}"[:300],
             fail_streak=lane.fail_streak,
         )
@@ -1491,9 +1528,10 @@ class BatchScheduler:
                 name=f"witt-serve-lane-{lane.index}",
             )
             lane.thread.start()
-        self.metrics.observe_lane_restart()
+        self.metrics.observe_lane_restart(ctx=lane.last_ctx)
         self.recorder.record(
-            "lane-restart", lane=lane.index, restarts=lane.restarts,
+            "lane-restart", ctx=lane.last_ctx, lane=lane.index,
+            restarts=lane.restarts,
         )
         return True
 
@@ -1625,6 +1663,9 @@ class BatchScheduler:
             draining = self._draining.is_set()
         store = get_compile_store()
         m = self.metrics
+        # pull-driven SLO evaluation: every health poll refreshes the
+        # burn-rate state (edge-triggered alerts fire here)
+        self.slo.evaluate()
         return {
             "queueDepth": self.queue.depth(),
             "queueCapacity": self.queue.max_depth,
@@ -1646,7 +1687,16 @@ class BatchScheduler:
             },
             "runCache": run_cache_info(),
             "errorKinds": taxonomy_counters(),
+            "alerts": self.slo.alert_counts(),
         }
+
+    def slo_status(self) -> dict:
+        """The /w/slo payload: burn-rate rows per registered SLO,
+        active (latched) alerts, alert counters, and the metric-history
+        digest they are computed from.  Evaluating here means any
+        poller keeps the alert state fresh (pull model — no evaluator
+        thread to supervise)."""
+        return self.slo.status(evaluate=True)
 
     def status(self) -> dict:
         return {
@@ -1669,6 +1719,7 @@ class BatchScheduler:
         from ..runtime.errors import taxonomy_counters
 
         self.metrics.add_prometheus(p, self.queue)
+        self.slo.add_prometheus(p)
         p.add(
             "serve_draining",
             1 if self._draining.is_set() else 0,

@@ -183,8 +183,10 @@ class Server:
             "node msgReceived sum",
             "counter",
         )
-        p.add("node_bytes_sent_total", s["bytesSent"], "", "counter")
-        p.add("node_bytes_received_total", s["bytesReceived"], "", "counter")
+        p.add("node_bytes_sent_total", s["bytesSent"],
+              "node bytesSent sum", "counter")
+        p.add("node_bytes_received_total", s["bytesReceived"],
+              "node bytesReceived sum", "counter")
         p.add(
             "messages_dropped_total",
             s["dropped"],

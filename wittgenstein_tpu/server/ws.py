@@ -38,6 +38,9 @@ including its start/stop asymmetry — /w/nodes/{id}/start vs
                                          dropped at the batch boundary)
   GET    /w/health                       liveness + fleet snapshot (always
                                          200 while the process serves HTTP)
+  GET    /w/slo                          SLO burn-rate status: per-objective
+                                         fast/slow burn, firing/latched
+                                         alerts, timeseries digest
   GET    /w/ready                        readiness: 200 when admitting, 503
                                          + Retry-After when draining or the
                                          sim backend is degraded
@@ -565,6 +568,16 @@ class WServer:
         if self.degraded_reason:
             h["degradedReason"] = self.degraded_reason
         return h
+
+    @route("GET", r"/w/slo", locked=False)
+    def slo(self, body):
+        """SLO burn-rate status (obs/slo.py): evaluates every
+        registered objective against the in-process timeseries NOW
+        (evaluation is pull-driven — reading this endpoint IS the
+        evaluator) and returns per-SLO burn rows, the latched active
+        alerts, cumulative alert counts, and a per-series digest.
+        Always 200: a firing SLO is a fact to report, not an error."""
+        return self.jobs.slo_status()
 
     @route("GET", r"/w/ready", locked=False)
     def ready(self, body):

@@ -240,8 +240,10 @@ def prometheus_from_counters(c: dict, prefix: str = "witt") -> str:
         "node msgReceived sum",
         "counter",
     )
-    p.add("node_bytes_sent_total", n["bytes_sent"], "", "counter")
-    p.add("node_bytes_received_total", n["bytes_received"], "", "counter")
+    p.add("node_bytes_sent_total", n["bytes_sent"],
+          "node bytesSent sum", "counter")
+    p.add("node_bytes_received_total", n["bytes_received"],
+          "node bytesReceived sum", "counter")
     p.add("done_nodes", n["done_nodes"], "nodes with done_at > 0")
     p.add("down_nodes", n["down_nodes"], "dead nodes")
     s = c["store"]
