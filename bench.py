@@ -496,6 +496,60 @@ def phase_profile(
                 "overflow_hwm": int(pocc["overflow_hwm"]),
             }
         )
+    # jump lever (ISSUE 18): pingpong declares TICK_INTERVAL=None, so
+    # the batched consensus-jump gate applies.  Two readings: a `jump`
+    # phase row — one next-arrival jump step beside one plain step in
+    # the same scan harness (op-cost ranking, like every phase row) —
+    # and a paired INTERLEAVED off/on wall of the identical batched
+    # chunk (the PR-11 noise discipline), with the armed run's
+    # skipped-ms census.  Pingpong at n=1000 post-warmup is the
+    # neutral-traffic case: the frac reports how much dead time even a
+    # dense schedule carries, and the wall pair prices the gate itself.
+    import jax.numpy as jnp
+
+    from wittgenstein_tpu.telemetry import counters as _tele_counters
+    from wittgenstein_tpu.telemetry.state import TelemetryConfig
+
+    jnet, jstate = make_pingpong(1000)
+    jnet, jstate = jnet.with_telemetry(jstate, TelemetryConfig())
+    jstate = jnet.run_ms(jstate, 150)
+    jstates = replicate_state(jstate, n_replicas)
+    jstats = scan_phase_seconds(
+        jstates,
+        {
+            "step": jnet.step,
+            "jump": lambda s: jnet._step_jump(s, s.time + jnp.int32(1 << 20)),
+        },
+        scans,
+        tracer,
+    )
+    off_run = jax.jit(lambda s: jnet.run_ms_batched(s, 200))
+    on_net = jnet.with_batched_jumps(True)
+    on_run = jax.jit(lambda s: on_net.run_ms_batched(s, 200))
+    jax.block_until_ready(off_run(jstates))  # compile + warm both
+    out_on = jax.block_until_ready(on_run(jstates))
+    offs, ons = [], []
+    for r in range(max(1, repeats)):
+        with tracer.span("jump-ab-off", repeat=r):
+            t0 = time.perf_counter()
+            jax.block_until_ready(off_run(jstates))
+            offs.append(time.perf_counter() - t0)
+        with tracer.span("jump-ab-on", repeat=r):
+            t0 = time.perf_counter()
+            out_on = jax.block_until_ready(on_run(jstates))
+            ons.append(time.perf_counter() - t0)
+    jump = {
+        "step_ms": r3(jstats["step"]["mean_s"]),
+        "jump_ms": r3(jstats["jump"]["mean_s"]),
+        "paired_wall_s": {
+            "off": [round(x, 3) for x in offs],
+            "on": [round(x, 3) for x in ons],
+        },
+        "speedup": round(min(offs) / max(min(ons), 1e-9), 3),
+        "jumped_ms_frac": _tele_counters(on_net, out_on)["loop"][
+            "jumped_ms_frac"
+        ],
+    }
     ablation = None
     if ablate:
         from wittgenstein_tpu.profiling import ablation_matrix, lever_report
@@ -517,6 +571,7 @@ def phase_profile(
         "handel_phases": phases,
         "handel_occupancy": occupancy,
         "pingpong_delivery_vs_capacity": scaling,
+        "jump": jump,
         "ablation": ablation,
     }
 
@@ -797,6 +852,12 @@ def _headline(
             else None
         ),
         "oracle_sims_per_sec": round(oracle, 4),
+        # jump efficacy of the measured run (None when the headline ran
+        # uninstrumented — the in-graph telemetry tier stays off for the
+        # headline number; the sweep/A-B records carry measured fracs)
+        "jumped_ms_frac": (
+            (result.get("counters") or {}).get("loop") or {}
+        ).get("jumped_ms_frac"),
         "parity": PARITY_STOP_WHEN_DONE,
         "rungs": rungs,
         "workload": (

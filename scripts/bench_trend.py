@@ -72,6 +72,7 @@ def _extract_record(tail: str):
             ("n_replicas", r'"n_replicas":\s*([0-9]+)', int),
             ("sim_ms", r'"sim_ms":\s*([0-9]+)', int),
             ("chunk_ms", r'"chunk_ms":\s*([0-9]+)', int),
+            ("jumped_ms_frac", r'"jumped_ms_frac":\s*([0-9.eE+-]+)', float),
         ):
             got = re.search(rx, chunk)
             if got:
@@ -141,6 +142,10 @@ def _round_row(path: str, budget) -> dict:
         "chunk_ms": cfg.get("chunk_ms", rec.get("chunk_ms")),
         "compile_s": rec.get("compile_s"),
         "run_s": rec.get("run_s"),
+        # jump efficacy (ISSUE 18): share of billed simulated ms the
+        # consensus-jump lever skipped; None when the round predates the
+        # lever or ran uninstrumented
+        "jumped_ms_frac": rec.get("jumped_ms_frac"),
         "rc": doc.get("rc"),
         # derivables, filled below when the inputs exist
         "us_per_tick": None,
@@ -252,6 +257,40 @@ def check(trend: dict) -> list:
                 f"{reg['drop_frac']:.1%} (> {REGRESSION_FRAC:.0%}) and the "
                 "newer round is below the floor — undocumented regression"
             )
+    # jump-efficacy gate (ISSUE 18): the floor file's optional "jump"
+    # block is the documentation channel for the dead-time lever's
+    # paired interleaved A/B.  Once a block is committed, a newer round
+    # whose measured jumped_ms_frac falls below the documented floor is
+    # an UNDOCUMENTED efficacy regression (the jump stopped skipping
+    # the dead time it was priced on); so is a committed block whose
+    # A/B contradicts the shipped default (ok: false — e.g. the lever
+    # armed by default while the paired walls record a loss).
+    # Re-recording the block with a note is the accepted-regression
+    # channel, same as the throughput floor.
+    jump = floor.get("jump")
+    if jump:
+        if not jump.get("ok", True):
+            problems.append(
+                "BENCH_FLOOR.json's jump block records an A/B that "
+                "contradicts the shipped default (note: "
+                f"{jump.get('note', 'none')!r}) — re-measure, flip the "
+                "default, or remove the block"
+            )
+        frac_floor = jump.get("jumped_ms_frac_floor")
+        measured = latest.get("jumped_ms_frac")
+        if (
+            frac_floor is not None
+            and measured is not None
+            and measured < frac_floor
+        ):
+            problems.append(
+                f"round {latest['round']} jumped_ms_frac {measured} is "
+                f"below the documented efficacy floor {frac_floor} — "
+                "an UNDOCUMENTED jump-efficacy regression.  Either "
+                "restore the lever or re-record the jump block in "
+                "BENCH_FLOOR.json with a note explaining the accepted "
+                "level."
+            )
     # the serve record gates itself (loadgen exits nonzero); here we
     # only refuse a committed record that says it failed
     serve = trend.get("serve")
@@ -269,6 +308,19 @@ def check(trend: dict) -> list:
             problems.append(
                 "BENCH_SERVE.json records SLO alerts during a fault-free "
                 f"benchmark: {alerts.get('by_slo')}"
+            )
+    # done-row harvesting (ISSUE 18): the serve record's optional
+    # "harvest" block carries the paired A/B of the compaction lever —
+    # a committed block whose A/B contradicts the shipped default
+    # (ok: false) is refused like any other failed benchmark
+    if serve is not None:
+        harvest = serve.get("harvest")
+        if harvest is not None and not harvest.get("ok", True):
+            problems.append(
+                "BENCH_SERVE.json's harvest block records an A/B that "
+                "contradicts the shipped default (note: "
+                f"{harvest.get('note', 'none')!r}) — re-measure, flip "
+                "the default, or remove the block"
             )
     # same discipline for the 2D-mesh ladder: a committed record whose
     # rungs broke bit-identity or channel ownership must not pass CI

@@ -1,0 +1,182 @@
+"""Batched consensus jumps (_run_ms_batched_jumps, ISSUE 18).
+
+The contract is bitwise identity, not plausibility: for every registered
+TICK_INTERVAL-None protocol, `with_batched_jumps(True).run_ms_batched`
+must equal the ungated vmapped fallback leaf-for-leaf — same RNG stream
+(send_ctr), same delivery ticks, same telemetry census, same fault
+accounting.  The sweep covers flat/wheel stores, telemetry on/off,
+faults-armed states, heterogeneous mid-run clocks and stop_when_done.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.core.registries import registry_batched_protocols
+from wittgenstein_tpu.engine.core import replicate_state, stack_states
+from wittgenstein_tpu.protocols.pingpong_batched import make_pingpong
+
+R = 3
+SIM_MS = 80
+
+JUMPABLE = [
+    e.name
+    for e in registry_batched_protocols.entries()
+    if e.contract_checks and e.factory()[0].protocol.TICK_INTERVAL is None
+]
+# >2 min compile-warm on the 1-core box: slow-tier only (the fast tier
+# still sweeps every other jumpable entry, both paths identically gated)
+_HEAVY = {"optimistic"}
+_SWEEP = [
+    pytest.param(n, marks=pytest.mark.slow) if n in _HEAVY else n
+    for n in JUMPABLE
+]
+
+
+def _assert_bitwise(got, want):
+    gl = jax.tree_util.tree_leaves(got)
+    wl = jax.tree_util.tree_leaves(want)
+    assert len(gl) == len(wl)
+    for g, w in zip(gl, wl):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def _both_paths(net, states, ms, stop_when_done=False):
+    base = net.run_ms_batched(states, ms, stop_when_done=stop_when_done)
+    jumped = net.with_batched_jumps(True).run_ms_batched(
+        states, ms, stop_when_done=stop_when_done
+    )
+    return base, jumped
+
+
+class TestRegistrySweep:
+    @pytest.mark.parametrize("name", _SWEEP)
+    def test_bitwise_identity(self, name):
+        """Every registered TICK_INTERVAL-None protocol (the faults-armed
+        p2pflood entry included): jump-armed == ungated, leaf for leaf."""
+        net, state = registry_batched_protocols.get(name).factory()
+        states = replicate_state(state, R, seeds=[5, 9, 21])
+        base, jumped = _both_paths(net, states, SIM_MS)
+        _assert_bitwise(jumped, base)
+
+    def test_tick_interval_one_unchanged(self):
+        """A per-ms protocol cannot jump: the gate must leave the lockstep
+        beat path alone (and stay bitwise, trivially)."""
+        net, state = registry_batched_protocols.get("gsf").factory()
+        assert net.protocol.TICK_INTERVAL == 1
+        states = replicate_state(state, R)
+        base, jumped = _both_paths(net, states, SIM_MS)
+        _assert_bitwise(jumped, base)
+
+
+class TestVariants:
+    def test_flat_vs_wheel(self):
+        """Jump identity on BOTH store layouts, and flat/wheel parity is
+        preserved under the gate (the test_timewheel oracle, jump-armed)."""
+        net_w, s_w = make_pingpong(128, seed=3)
+        net_f, s_f = make_pingpong(128, seed=3, wheel_rows=0)
+        assert not net_w.flat and net_f.flat
+        st_w = replicate_state(s_w, R)
+        st_f = replicate_state(s_f, R)
+        base_w, jump_w = _both_paths(net_w, st_w, 200)
+        base_f, jump_f = _both_paths(net_f, st_f, 200)
+        _assert_bitwise(jump_w, base_w)
+        _assert_bitwise(jump_f, base_f)
+        for a, b in (
+            (jump_w.proto["pong"], jump_f.proto["pong"]),
+            (jump_w.send_ctr, jump_f.send_ctr),
+            (jump_w.msg_received, jump_f.msg_received),
+        ):
+            assert jnp.array_equal(a, b)
+
+    def test_telemetry_census_identical(self):
+        """Telemetry armed: the consensus path must produce the exact
+        tick/jump/jumped_ms census of the ungated path, and actually
+        jump (pingpong traffic is sparse at n=64)."""
+        from wittgenstein_tpu.telemetry.state import TelemetryConfig
+
+        net, state = make_pingpong(64)
+        tnet, tstate = net.with_telemetry(state, TelemetryConfig())
+        states = replicate_state(tstate, R, seeds=[7, 11, 13])
+        base, jumped = _both_paths(tnet, states, 150)
+        _assert_bitwise(jumped, base)
+        assert (np.asarray(jumped.tele.jumps) > 0).all()
+        assert (np.asarray(jumped.tele.jumped_ms) > 0).all()
+
+    def test_counters_and_prometheus_surface_jump_census(self):
+        """The export tier carries the efficacy signal bench_trend
+        gates on: counters()'s loop block aggregates jumps/jumped_ms
+        with a jumped_ms_frac share, and the Prometheus text exposes
+        the same families."""
+        from wittgenstein_tpu.telemetry import counters
+        from wittgenstein_tpu.telemetry.export import (
+            prometheus_from_counters,
+        )
+        from wittgenstein_tpu.telemetry.state import TelemetryConfig
+
+        net, state = make_pingpong(64)
+        tnet, tstate = net.with_telemetry(state, TelemetryConfig())
+        jnet = tnet.with_batched_jumps(True)
+        out = jnet.run_ms_batched(
+            replicate_state(tstate, R, seeds=[7, 11, 13]), 150
+        )
+        c = counters(jnet, out)
+        loop = c["loop"]
+        assert loop["jumps"] > 0 and loop["jumped_ms"] > 0
+        assert 0 < loop["jumped_ms_frac"] <= 1
+        assert loop["jumped_ms"] / max(1, int(np.asarray(out.time).sum())) \
+            == pytest.approx(loop["jumped_ms_frac"], abs=1e-6)
+        assert loop["jumped_ms_min"] <= loop["jumped_ms_max"]
+        text = prometheus_from_counters(c)
+        for family in ("witt_jumps_total", "witt_jumped_ms_total",
+                       "witt_jumped_ms_frac"):
+            assert family in text, family
+
+    def test_heterogeneous_clocks(self):
+        """Stacked mid-run states with non-uniform clocks: the consensus
+        tick walks the union of lane tick sets and every lane still gets
+        exactly its own singleton stream."""
+        net, state = make_pingpong(64)
+        lanes = []
+        for i, warm in enumerate((0, 37, 81)):
+            s = state._replace(seed=jnp.int32(100 + i))
+            if warm:
+                s = net.run_ms(s, warm)
+            lanes.append(s)
+        states = stack_states(lanes)
+        assert len(set(np.asarray(states.time).tolist())) == 3
+        base, jumped = _both_paths(net, states, 90)
+        _assert_bitwise(jumped, base)
+
+    def test_stop_when_done(self):
+        """Quiescence gating composes: per-lane all_done/pending tests
+        match the ungated loop's semantics bit for bit."""
+        net, state = registry_batched_protocols.get("p2pflood").factory()
+        states = replicate_state(state, R, seeds=[2, 4, 8])
+        base, jumped = _both_paths(net, states, SIM_MS, stop_when_done=True)
+        _assert_bitwise(jumped, base)
+
+    def test_singleton_parity(self):
+        """Each jump-armed batched lane equals its own singleton run —
+        the per-row contract done-row harvesting relies on."""
+        net, state = make_pingpong(64)
+        states = replicate_state(state, R, seeds=[31, 32, 33])
+        jumped = net.with_batched_jumps(True).run_ms_batched(states, 120)
+        for i, seed in enumerate((31, 32, 33)):
+            single = net.run_ms(state._replace(seed=jnp.int32(seed)), 120)
+            for got, want in zip(
+                jax.tree_util.tree_leaves(jumped),
+                jax.tree_util.tree_leaves(single),
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(got)[i], np.asarray(want)
+                )
+
+    def test_cache_key_distinguishes_gate(self):
+        net, _ = make_pingpong(64)
+        jnet = net.with_batched_jumps(True)
+        assert net.cache_key() != jnet.cache_key()
+        assert net.stable_cache_key() != jnet.stable_cache_key()
+        assert jnet.with_batched_jumps(False).stable_cache_key() == \
+            net.stable_cache_key()

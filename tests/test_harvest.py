@@ -1,0 +1,161 @@
+"""Done-row harvesting (serve/scheduler.py _maybe_harvest, ISSUE 18).
+
+A chunked batch whose members finalize at different horizon boundaries
+compacts its survivors into the next-smaller power-of-two capacity
+bucket mid-run.  The contract: every job's result digest — harvested or
+not, remainder or not — still equals its fault-free run_singleton; the
+narrower widths are one-time run-cache geometries (re-harvests of the
+same width compile nothing); and the lever is default-ON (the paired
+A/B in BENCH_SERVE.json: +40% aggregate sims/s on the mixed-horizon
+scenario, within noise on uniform horizons) but disables cleanly.
+"""
+
+import numpy as np
+import pytest
+
+from wittgenstein_tpu.parallel.replica_shard import run_cache_info
+from wittgenstein_tpu.serve import BatchScheduler, JobState
+
+BASE = {"protocol": "PingPong", "params": {"node_ct": 32}}
+
+
+def _drain(sched):
+    while sched.drain_once():
+        pass
+
+
+def _sched(**kw):
+    kw.setdefault("auto_start", False)
+    kw.setdefault("max_batch_replicas", 4)
+    kw.setdefault("horizon_quantum_ms", 50)
+    kw.setdefault("harvest", True)
+    return BatchScheduler(**kw)
+
+
+class TestHarvest:
+    def test_survivors_bitwise_after_compaction(self):
+        """3 of 4 members finish at chunk 2; the 230ms survivor (with a
+        30ms quantum remainder) is harvested to a 1-row batch and must
+        still match its singleton digest — as must the pre-harvest
+        finishers."""
+        sched = _sched()
+        specs = [
+            {**BASE, "seed": 1, "simMs": 100},
+            {**BASE, "seed": 2, "simMs": 100},
+            {**BASE, "seed": 3, "simMs": 100},
+            {**BASE, "seed": 4, "simMs": 230},
+        ]
+        jobs = [sched.submit(s) for s in specs]
+        assert len({j.compat for j in jobs}) == 1
+        assert sched.drain_once()  # slice 1: 2 chunks, 3 members finish
+        parked = sched._parked
+        assert len(parked) == 1 and parked[0].capacity == 1, (
+            "survivor not compacted to the 1-row bucket"
+        )
+        assert parked[0].batch_id.endswith("-h1")
+        assert parked[0].job_chunks == [2] and parked[0].job_rems == [30]
+        _drain(sched)
+        for j, s in zip(jobs, specs):
+            assert j.state is JobState.DONE, (s, j.error)
+            assert j.result["time"] == s["simMs"]
+            assert j.result["digest"] == sched.run_singleton(s)["digest"], s
+        m = sched.metrics.summary()
+        assert m["harvests_total"] == 1
+        assert m["harvest_rows_freed_total"] == 3
+        # the supervisor's row_watch census observed the chunk syncs
+        assert sched.metrics.timeseries.count("serve.rows_done") > 0
+
+    def test_faulty_survivor_matches_fault_free_singleton_schedule(self):
+        """Fault plans ride the gathered rows: a crashed-node survivor
+        harvests bitwise too (its own singleton replays the same plan),
+        and a fault-free rider is untouched by the compaction."""
+        faulty = {
+            **BASE, "seed": 7, "simMs": 200,
+            "faults": [{"op": "crash", "nodes": [1, 2], "at": 10}],
+        }
+        clean = {**BASE, "seed": 8, "simMs": 200}
+        shorts = [
+            {**BASE, "seed": 9, "simMs": 50},
+            {**BASE, "seed": 10, "simMs": 50},
+        ]
+        sched = _sched(slice_chunks=1)
+        jobs = [sched.submit(s) for s in [faulty, clean] + shorts]
+        _drain(sched)
+        for j, s in zip(jobs, [faulty, clean] + shorts):
+            assert j.state is JobState.DONE, (s, j.error)
+            assert j.result["digest"] == sched.run_singleton(s)["digest"], s
+        assert sched.metrics.summary()["harvests_total"] == 1
+
+    def test_bucket_widths_compile_once(self):
+        """Compile discipline: a second workload harvesting to the SAME
+        bucket width re-uses the run cache's geometry program — zero new
+        compiles (the mixed-workload compile pin, harvest included)."""
+        sched = _sched()
+
+        def workload(base_seed):
+            specs = [
+                {**BASE, "seed": base_seed + i, "simMs": ms}
+                for i, ms in enumerate((100, 100, 100, 200))
+            ]
+            jobs = [sched.submit(s) for s in specs]
+            _drain(sched)
+            assert all(j.state is JobState.DONE for j in jobs)
+
+        workload(100)
+        assert sched.metrics.summary()["harvests_total"] == 1
+        c0 = dict(run_cache_info())
+        workload(200)
+        c1 = dict(run_cache_info())
+        assert sched.metrics.summary()["harvests_total"] == 2
+        assert c1["compiles"] == c0["compiles"], (
+            "re-harvest to a known bucket width recompiled"
+        )
+
+    def test_no_harvest_when_disabled_or_no_win(self):
+        """harvest=False opts out entirely (the lever defaults on).
+        And with harvest on, a batch whose survivors still need the
+        full bucket stays at its width (no thrash)."""
+        assert BatchScheduler(auto_start=False).harvest is True
+        off = BatchScheduler(
+            auto_start=False, max_batch_replicas=4,
+            horizon_quantum_ms=50, harvest=False,
+        )
+        assert off.harvest is False
+        jobs = [
+            off.submit({**BASE, "seed": i, "simMs": ms})
+            for i, ms in enumerate((100, 200, 200, 200))
+        ]
+        assert off.drain_once()
+        assert off._parked and off._parked[0].capacity == 4
+        _drain(off)
+        assert all(j.state is JobState.DONE for j in jobs)
+        assert off.metrics.summary()["harvests_total"] == 0
+
+        on = _sched()
+        jobs = [
+            on.submit({**BASE, "seed": i, "simMs": ms})
+            for i, ms in enumerate((100, 200, 200, 200))
+        ]
+        assert on.drain_once()
+        # 3 survivors -> bucket 4 == capacity: no win, no swap
+        assert on._parked and on._parked[0].capacity == 4
+        _drain(on)
+        assert all(j.state is JobState.DONE for j in jobs)
+        assert on.metrics.summary()["harvests_total"] == 0
+
+    def test_prometheus_surfaces_harvest_counters(self):
+        from wittgenstein_tpu.telemetry.export import PromText
+
+        sched = _sched()
+        jobs = [
+            sched.submit({**BASE, "seed": i, "simMs": ms})
+            for i, ms in enumerate((100, 100, 100, 200))
+        ]
+        _drain(sched)
+        assert all(j.state is JobState.DONE for j in jobs)
+        p = PromText()
+        sched.metrics.add_prometheus(p, sched.queue)
+        text = p.render()
+        assert "witt_serve_harvests_total 1" in text
+        assert "witt_serve_harvest_rows_freed_total 3" in text
+        assert "witt_serve_rows_done" in text

@@ -302,8 +302,12 @@ class TestHorizonSharding:
     (remainders ride a 1-row run), all bitwise-equal to singletons."""
 
     def test_mixed_horizons_share_family_and_match_singletons(self):
+        # harvest off: this pin counts the horizon-sharding programs
+        # alone; the harvest-on compile discipline (bucket widths are
+        # one-time geometries) is pinned in test_harvest.py
         sched = BatchScheduler(
             auto_start=False, max_batch_replicas=4, horizon_quantum_ms=50,
+            harvest=False,
         )
         specs = [
             {**BASE, "seed": 1, "simMs": 100},

@@ -435,6 +435,73 @@ def test_sl901_detects_headroom_violation():
     )
 
 
+def test_sl1201_detects_beating_jumpable_protocol():
+    import jax.numpy as jnp
+
+    from wittgenstein_tpu.analysis.contracts import check_entry
+    from wittgenstein_tpu.protocols.pingpong_batched import BatchedPingPong
+
+    assert BatchedPingPong.TICK_INTERVAL is None
+
+    class LyingJumper(BatchedPingPong):
+        # inherits TICK_INTERVAL=None (jumpable) but does per-tick work
+        # the next-arrival jump paths would silently skip
+        def tick_beat(self, net, state):
+            return state._replace(
+                proto={"pong": state.proto["pong"] + jnp.int32(1)}
+            )
+
+    findings = check_entry(
+        _entry_with_protocol(LyingJumper), root=str(REPO_ROOT)
+    )
+    assert any(
+        f.rule == "SL1201" and "not a no-op" in f.message
+        for f in findings
+    )
+
+
+def test_sl1201_detects_beat_period_contradiction():
+    from wittgenstein_tpu.analysis.contracts import check_entry
+    from wittgenstein_tpu.protocols.pingpong_batched import BatchedPingPong
+
+    class PeriodicJumper(BatchedPingPong):
+        # periodic beat work declared on a jumpable protocol: the two
+        # declarations contradict each other
+        BEAT_PERIOD = 10
+        BEAT_RESIDUES = (0,)
+        BEAT_SEND_CALLS = 0
+
+    findings = check_entry(
+        _entry_with_protocol(PeriodicJumper), root=str(REPO_ROOT)
+    )
+    assert any(
+        f.rule == "SL1201" and "BEAT_PERIOD" in f.message
+        for f in findings
+    )
+
+
+def test_sl1201_quiet_on_declared_tick_interval():
+    import jax.numpy as jnp
+
+    from wittgenstein_tpu.analysis.contracts import check_entry
+    from wittgenstein_tpu.protocols.pingpong_batched import BatchedPingPong
+
+    class HonestBeater(BatchedPingPong):
+        # the same mutating beat is fine once the protocol stops
+        # claiming its ticks are skippable
+        TICK_INTERVAL = 1
+
+        def tick_beat(self, net, state):
+            return state._replace(
+                proto={"pong": state.proto["pong"] + jnp.int32(0)}
+            )
+
+    findings = check_entry(
+        _entry_with_protocol(HonestBeater), root=str(REPO_ROOT)
+    )
+    assert not any(f.rule == "SL1201" for f in findings)
+
+
 def test_sl601_clean_on_pingpong():
     from wittgenstein_tpu.analysis.annotations_check import (
         check_annotations_entry,

@@ -1,4 +1,5 @@
-"""Abstract-eval contract checks (SL401-SL404, SL406-SL407, SL701, SL901).
+"""Abstract-eval contract checks (SL401-SL404, SL406-SL407, SL701,
+SL901, SL1201).
 
 These rules run the real engine code under JAX's abstract interpreter
 instead of reading its text: every protocol registered in
@@ -39,6 +40,11 @@ SL701  derived-cache consistency: a protocol declaring
        ticks (so deliver, commits and periodic work all execute) and
        every declared leaf is compared bitwise against the oracle — a
        stale-cache bug cannot ship silently.
+SL1201 jump-safety audit: TICK_INTERVAL=None promises every
+       inter-arrival tick is empty (the next-arrival jump paths —
+       singleton _step_jump and the batched consensus jump — skip them
+       outright), so the protocol's tick_beat must trace to a structural
+       no-op and BEAT_PERIOD must stay undeclared.
 SL901  narrow-dtype overflow audit: the engine's message-lane plan must
        cover (N-1, n_msg_types-1), every NARROW_LEAVES declaration
        (engine.density) must match its live leaf's dtype with the
@@ -565,6 +571,70 @@ def _check_narrow_overflow(jax, name, net, state, path, line, suppress):
     return findings
 
 
+def _check_jump_safety(jax, name, net, state, path, line, suppress):
+    """SL1201: TICK_INTERVAL=None is the jump-safety declaration — the
+    singleton next-arrival fast path (_step_jump) and the batched
+    consensus jump both skip inter-arrival ticks OUTRIGHT on its
+    strength.  A skipped tick has empty occupancy by construction, but
+    tick_beat does not read occupancy: anything it writes would have run
+    on those ticks in the ungated loop, so the declaration is only sound
+    when the traced tick_beat is a structural no-op (every output leaf
+    the SAME jaxpr variable as its input — the SL402 passthrough
+    criterion).  Declaring BEAT_PERIOD alongside TICK_INTERVAL=None is
+    the same contradiction stated twice and is flagged on its own."""
+    if net.protocol.TICK_INTERVAL is not None:
+        return []
+    findings = []
+    if getattr(net.protocol, "BEAT_PERIOD", None) is not None:
+        f = _mk("SL1201", path, line,
+                f"[{name}] declares TICK_INTERVAL=None (jumpable) AND "
+                f"BEAT_PERIOD={net.protocol.BEAT_PERIOD}: periodic beat "
+                "work contradicts the empty-tick declaration the jump "
+                "paths rely on", suppress)
+        if f:
+            findings.append(f)
+    try:
+        closed, out_shape = jax.make_jaxpr(
+            lambda s: net.protocol.tick_beat(net, s), return_shape=True
+        )(state)
+    except Exception as e:
+        f = _mk("SL1201", path, line,
+                f"[{name}] tick_beat failed tracing for the jump-safety "
+                f"audit: {type(e).__name__}: {e}", suppress)
+        return findings + ([f] if f else [])
+    if jax.tree_util.tree_structure(out_shape) != jax.tree_util.tree_structure(
+        state
+    ):
+        f = _mk("SL1201", path, line,
+                f"[{name}] tick_beat changes the SimState tree structure "
+                "on a TICK_INTERVAL=None protocol — the jump paths skip "
+                "its per-tick effects entirely", suppress)
+        return findings + ([f] if f else [])
+    invars = closed.jaxpr.invars
+    outvars = closed.jaxpr.outvars
+    touched = [k for k in range(len(outvars)) if outvars[k] is not invars[k]]
+    if touched:
+        leaf_names = [p for p, _ in _leaf_paths(jax, state)]
+        names = ", ".join(
+            leaf_names[k] if k < len(leaf_names) else f"leaf {k}"
+            for k in touched[:_MAX_LEAF_REPORTS]
+        )
+        more = (
+            "" if len(touched) <= _MAX_LEAF_REPORTS
+            else f" (+{len(touched) - _MAX_LEAF_REPORTS} more)"
+        )
+        f = _mk("SL1201", path, line,
+                f"[{name}] declares TICK_INTERVAL=None but tick_beat is "
+                f"not a no-op: {len(touched)} leaf(s) are not input "
+                f"passthroughs ({names}{more}).  The next-arrival jump "
+                "skips empty-occupancy ticks wholesale, so this per-tick "
+                "work would silently vanish on the jumped path; declare "
+                "TICK_INTERVAL/BEAT_PERIOD instead", suppress)
+        if f:
+            findings.append(f)
+    return findings
+
+
 def _check_recompile(jax, name, net, state, out_shape, path, line, suppress):
     """SL404: step output avals == input avals (jit-cache stability) and
     trace determinism."""
@@ -601,8 +671,8 @@ def _check_recompile(jax, name, net, state, out_shape, path, line, suppress):
 
 
 def check_entry(entry, root: str = ".") -> List[Finding]:
-    """Run SL401-SL404 + SL406-SL407 + SL701 + SL901 for one registry
-    entry; []
+    """Run SL401-SL404 + SL406-SL407 + SL701 + SL901 + SL1201 for one
+    registry entry; []
     when clean or when the entry opts out of contract checks (standalone
     engines)."""
     jax = _cpu_jax()
@@ -635,6 +705,9 @@ def check_entry(entry, root: str = ".") -> List[Finding]:
         jax, entry.name, net, state, path, line, suppress
     )
     findings += _check_narrow_overflow(
+        jax, entry.name, net, state, path, line, suppress
+    )
+    findings += _check_jump_safety(
         jax, entry.name, net, state, path, line, suppress
     )
     findings += _check_recompile(

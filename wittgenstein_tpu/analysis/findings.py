@@ -105,6 +105,11 @@ RULES: Dict[str, str] = {
     "or an slo=... keyword — names a string literal missing from "
     "REGISTERED_SLOS, so a dashboard keyed on the catalog would "
     "silently miss its alerts",
+    # -- jump-safety audit --------------------------------------------------------
+    "SL1201": "jump-safety audit: a protocol declaring TICK_INTERVAL=None "
+    "whose tick_beat jaxpr is not a no-op (or that also declares "
+    "BEAT_PERIOD) — the next-arrival jump paths skip empty-occupancy "
+    "ticks wholesale, so per-tick beat work would silently vanish",
 }
 
 

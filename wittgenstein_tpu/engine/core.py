@@ -215,6 +215,7 @@ class BatchedNetwork:
         annotate: bool = True,
         fuse_step: bool = False,
         narrow_lanes: Optional[bool] = None,
+        batched_jumps: bool = False,
     ):
         self.protocol = protocol
         self.latency = latency
@@ -238,6 +239,14 @@ class BatchedNetwork:
         # because its per-phase scopes are what --phase-profile and the
         # SL601 annotation checks attribute against.
         self.fuse_step = bool(fuse_step)
+        # STATIC switch for the batched consensus-jump loop
+        # (_run_ms_batched_jumps, docs/engine_timewheel.md): replicas
+        # advance time in lockstep and the whole batch jumps to the
+        # minimum next-arrival across the replica axis.  Bit-identical to
+        # the ungated vmapped fallback by construction (each lane steps
+        # at exactly its own singleton tick set); default-off pending the
+        # paired A/B in BENCH_FLOOR.json (profiling.md lever ledger)
+        self.batched_jumps = bool(batched_jumps)
         # STATIC switch: None compiles the exact pre-telemetry program
         # (state.tele is an empty pytree); a TelemetryConfig threads the
         # counter side-car through every send/deliver/jump site below
@@ -388,6 +397,7 @@ class BatchedNetwork:
             self.faults.key() if self.faults is not None else None,
             self.annotate,
             self.fuse_step,
+            self.batched_jumps,
             self.lanes.key(),
             # the bitset-kernel backend is read from the environment at
             # trace time (WITT_BITOPS) — fold it in so a flipped override
@@ -419,6 +429,7 @@ class BatchedNetwork:
             self.faults.key() if self.faults is not None else None,
             self.annotate,
             self.fuse_step,
+            self.batched_jumps,
             self.lanes.key(),
             bitops_backend(),
         )
@@ -505,6 +516,18 @@ class BatchedNetwork:
 
         net = copy.copy(self)
         net.fuse_step = bool(fuse)
+        return net
+
+    def with_batched_jumps(self, jumps: bool = True) -> "BatchedNetwork":
+        """Engine copy with the batched consensus-jump loop toggled
+        (fresh jit identity via cache_key, same pattern as
+        with_fuse_step).  Only changes which program run_ms_batched
+        traces for TICK_INTERVAL-None protocols; results are
+        bit-identical either way."""
+        import copy
+
+        net = copy.copy(self)
+        net.batched_jumps = bool(jumps)
         return net
 
     # -- partitions (Network.partition, Network.java:693-707) ----------------
@@ -1250,10 +1273,80 @@ class BatchedNetwork:
         fn = self._run_ms_donated if donate else self._run_ms
         return fn(state, ms, stop_when_done)
 
+    def _run_ms_batched_jumps(
+        self, states: SimState, ms: int, stop_when_done: bool
+    ) -> SimState:
+        """Consensus-jump loop for TICK_INTERVAL-None protocols: the time
+        loop runs OUTSIDE the vmap and every iteration executes ONE
+        replica-uniform tick — the minimum clock over still-running lanes
+        — then each lane's own `_step_jump` advances it past its empty
+        milliseconds exactly as on the singleton path.
+
+        Bitwise identity with the ungated vmapped fallback is by
+        construction, not by an emptiness argument: a lane steps iff the
+        consensus tick equals its own clock, and lane clocks only move
+        when the lane steps, so each lane executes exactly its singleton
+        tick set (same per-event RNG stream — every executed tick burns
+        one send_ctr).  Lanes not at the consensus tick are computed and
+        discarded by the element-wise select, like any masked vmap lane.
+
+        What the gate buys over the fallback: `time` is carried as a
+        loop-scalar, so the wheel-row addressing inside the step
+        (delivery gather, occupancy rotation) is replica-uniform —
+        shared dynamic slices instead of per-lane gathers.  Iterations
+        count the UNION of lane tick sets rather than the per-lane max,
+        so the lever is priced by the paired A/B (profiling.md), not
+        assumed."""
+        proto = self.protocol
+        ends = states.time + ms  # per-lane horizon, like _run_ms_impl
+
+        def lane_alive(s, e):
+            c = s.time < e
+            if stop_when_done:
+                c = c & ~proto.all_done(s)
+                # quiescence: no pending message and no per-ms tick work
+                # means nothing can ever change — stop scanning
+                c = c & (self.pending_messages(s) > 0)
+            return c
+
+        alive_v = jax.vmap(lane_alive)
+        # time rides as an UNBATCHED scalar through the step: every lane
+        # that executes does so at the shared consensus tick, so wheel
+        # addressing is replica-uniform (the whole point of the gate)
+        axes = SimState(
+            **{f: (None if f == "time" else 0) for f in SimState._fields}
+        )
+        jump_v = jax.vmap(self._step_jump, in_axes=(axes, 0), out_axes=0)
+
+        def w_cond(ss):
+            return jnp.any(alive_v(ss, ends))
+
+        def w_body(ss):
+            alive = alive_v(ss, ends)
+            t = jnp.min(
+                jnp.where(alive, ss.time, jnp.int32(INT_MAX))
+            ).astype(jnp.int32)
+            active = alive & (ss.time == t)
+            stepped = jump_v(ss._replace(time=t), ends)
+            return jax.tree_util.tree_map(
+                lambda new, old: jnp.where(
+                    active.reshape(active.shape + (1,) * (old.ndim - 1)),
+                    new,
+                    old,
+                ),
+                stepped,
+                ss,
+            )
+
+        states = lax.while_loop(w_cond, w_body, states)
+        return states._replace(time=ends)
+
     def _run_ms_batched_impl(
         self, states: SimState, ms: int, stop_when_done: bool
     ) -> SimState:
         proto = self.protocol
+        if self.batched_jumps and proto.TICK_INTERVAL is None:
+            return self._run_ms_batched_jumps(states, ms, stop_when_done)
         period, residues = proto.BEAT_PERIOD, proto.BEAT_RESIDUES
         if (
             proto.TICK_INTERVAL != 1

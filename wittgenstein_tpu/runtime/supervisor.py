@@ -188,6 +188,7 @@ class Supervisor:
         placement: Optional[Callable[[Any], Any]] = None,
         timeseries: Any = None,
         sentinel: Any = None,
+        row_watch: Optional[Callable[[Any, int], None]] = None,
     ):
         if n_chunks < 1:
             raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
@@ -241,6 +242,13 @@ class Supervisor:
         # swallows; sentinel.check never raises by contract)
         self.timeseries = timeseries
         self.sentinel = sentinel
+        # done-row watcher (serve's harvesting census): called at the
+        # same per-chunk sync with (synced_state, chunk_index).  The
+        # per-chunk sync is the ONLY place done_at/all_done are already
+        # host-materialized, so mid-batch row observations are free
+        # here and nowhere else.  Same contract as the sentinel: reads
+        # only, never fails the run (_observe_chunk swallows)
+        self.row_watch = row_watch
         self._wd_worker: Optional[WatchdogWorker] = None
         self._first_call_done = False
         self._degraded = False
@@ -330,6 +338,7 @@ class Supervisor:
             return {
                 "ticks": int(np.asarray(tele.ticks).sum()),
                 "jumps": int(np.asarray(tele.jumps).sum()),
+                "jumped_ms": int(np.asarray(tele.jumped_ms).sum()),
                 "wheel_fill_hwm": int(np.asarray(tele.wheel_fill_hwm).max()),
                 "ovf_hwm": int(np.asarray(tele.ovf_hwm).max()),
             }
@@ -356,6 +365,11 @@ class Supervisor:
                         self.timeseries.observe(
                             f"supervisor.{key}", float(hwms[key]), ctx=ctx
                         )
+            except Exception:  # noqa: BLE001 — monitoring is best-effort
+                pass
+        if self.row_watch is not None:
+            try:
+                self.row_watch(state, chunk)
             except Exception:  # noqa: BLE001 — monitoring is best-effort
                 pass
         if self.sentinel is not None:

@@ -85,6 +85,11 @@ class ServeMetrics:
         self.salvage_runs_total = 0
         self.salvage_seconds_total = 0.0
         self.drains_total = 0
+        # done-row harvesting: parked batches compacted to a smaller
+        # capacity bucket mid-run, and the padding rows freed by it
+        self.harvests_total = 0
+        self.harvest_rows_freed_total = 0
+        self.rows_done_last = 0
         self._latency_s = deque(maxlen=self.WINDOW)
         self._ttfr_s = deque(maxlen=self.WINDOW)
         # (run_id, tenant, latency_s) of recently completed jobs
@@ -252,6 +257,26 @@ class ServeMetrics:
         with self._lock:
             self.drains_total += 1
 
+    def observe_harvest(self, rows_freed: int, ctx=None) -> None:
+        """One parked batch compacted into a smaller capacity bucket:
+        ``rows_freed`` replica rows stop being re-run each slice."""
+        with self._lock:
+            self.harvests_total += 1
+            self.harvest_rows_freed_total += rows_freed
+        self.timeseries.inc("serve.harvests_total", ctx=ctx)
+
+    def observe_rows_done(self, done: int, total: int, ctx=None) -> None:
+        """Per-chunk done-row census from the Supervisor's row_watch
+        hook (how many member rows have reached their protocol's
+        all_done — the signal harvesting efficacy is judged by)."""
+        with self._lock:
+            self.rows_done_last = done
+        self.timeseries.observe("serve.rows_done", float(done), ctx=ctx)
+        if total:
+            self.timeseries.observe(
+                "serve.rows_done_frac", done / total, ctx=ctx
+            )
+
     # -- export --------------------------------------------------------
 
     def latency_quantiles(self) -> dict:
@@ -300,6 +325,9 @@ class ServeMetrics:
                     self.salvage_seconds_total, 4
                 ),
                 "drains_total": self.drains_total,
+                "harvests_total": self.harvests_total,
+                "harvest_rows_freed_total": self.harvest_rows_freed_total,
+                "rows_done_last": self.rows_done_last,
             }
         if queue_depth is not None:
             out["queue_depth"] = queue_depth
@@ -375,6 +403,14 @@ class ServeMetrics:
             p.add("serve_drains_total", self.drains_total,
                   "graceful drains entered via the admin surface",
                   "counter")
+            p.add("serve_harvests_total", self.harvests_total,
+                  "parked batches compacted to a smaller capacity "
+                  "bucket mid-run", "counter")
+            p.add("serve_harvest_rows_freed_total",
+                  self.harvest_rows_freed_total,
+                  "replica rows freed by done-row harvesting", "counter")
+            p.add("serve_rows_done", self.rows_done_last,
+                  "member rows at all_done in the most recent chunk sync")
             for lane, n in sorted(self._lane_dispatches.items()):
                 p.add("serve_lane_dispatches_total", n,
                       "dispatches issued per lane", "counter",

@@ -123,10 +123,27 @@ def counters(net, state) -> dict:
             "wheel_fill_hwm": smax(tele.wheel_fill_hwm),
             "overflow_hwm": smax(tele.ovf_hwm),
         }
+        # jump efficacy: jumped_ms_frac is the share of simulated
+        # milliseconds skipped as provably-empty (per-replica census
+        # summed; the denominator is the summed final clocks, i.e. the
+        # total ms the batch was billed for).  min/max over replicas
+        # bound the spread without an unbounded per-replica list in
+        # every record — BENCH's jump-efficacy gate reads the frac
+        jumps = np.asarray(tele.jumps).reshape(-1)
+        jmd = np.asarray(tele.jumped_ms).reshape(-1)
         out["loop"] = {
             "ticks": ssum(tele.ticks),
             "jumps": ssum(tele.jumps),
             "jumped_ms": ssum(tele.jumped_ms),
+            "jumped_ms_frac": round(
+                float(jmd.sum())
+                / max(1, int(np.asarray(state.time).sum())),
+                6,
+            ),
+            "jumps_min": int(jumps.min()),
+            "jumps_max": int(jumps.max()),
+            "jumped_ms_min": int(jmd.min()),
+            "jumped_ms_max": int(jmd.max()),
         }
     if getattr(net, "faults", None) is not None:
         fs = state.faults
@@ -296,6 +313,13 @@ def prometheus_from_counters(c: dict, prefix: str = "witt") -> str:
         p.add("ticks_total", loop["ticks"], "executed engine ticks", "counter")
         p.add("jumps_total", loop["jumps"], "empty-ms jumps", "counter")
         p.add("jumped_ms_total", loop["jumped_ms"], "ms skipped", "counter")
+        if "jumped_ms_frac" in loop:
+            p.add("jumped_ms_frac", loop["jumped_ms_frac"],
+                  "share of simulated ms skipped as provably empty")
+            for stat in ("jumps_min", "jumps_max",
+                         "jumped_ms_min", "jumped_ms_max"):
+                p.add(f"loop_{stat}", loop[stat],
+                      "per-replica jump census spread")
     fl = c.get("faults")
     if fl:
         for name, v in zip(c["mtypes"], fl["dropped_by_fault"]):
