@@ -309,6 +309,18 @@ def check(trend: dict) -> list:
                 "BENCH_SERVE.json records SLO alerts during a fault-free "
                 f"benchmark: {alerts.get('by_slo')}"
             )
+    # concurrency contract: the serve record's armed lock-trace probe
+    # must have seen ZERO lock-order violations — a committed record
+    # carrying one documents a deadlock-order bug and must not pass CI
+    if serve is not None:
+        lt = serve.get("lockTrace") or {}
+        if lt.get("violationCount"):
+            problems.append(
+                "BENCH_SERVE.json's lock-trace probe recorded "
+                f"{lt['violationCount']} lock-order violation(s) — the "
+                "fleet inverted LOCK_HIERARCHY at runtime; fix the "
+                "acquisition order (see docs/serving.md, Lock hierarchy)"
+            )
     # done-row harvesting (ISSUE 18): the serve record's optional
     # "harvest" block carries the paired A/B of the compaction lever —
     # a committed block whose A/B contradicts the shipped default

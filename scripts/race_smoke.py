@@ -1,0 +1,174 @@
+"""Race smoke: the serving fleet under WITT_LOCK_TRACE=1.
+
+Boots an in-process fleet with lock tracing armed and throws a
+concurrent submit / drain / failover / harvest storm at it:
+
+  * three submitter threads race 9 direct jobs into the queue while the
+    lanes claim and dispatch them;
+  * a lane thread is killed mid-storm (inject_lane_failure) so the
+    failover path — rebinding, salvage, restart — runs under trace;
+  * a chunked wave (simMs > chunkMs) parks, slices, and resumes so the
+    preemption/harvest bookkeeping runs under trace;
+  * a drain()/undrain() cycle interleaves with the chunked wave.
+
+Gates (any miss is a nonzero exit, for tier1.yml):
+
+  1. ZERO ``lock-order-violation`` events — TracedLock's runtime
+     acquisition-order audit agrees with the static LOCK_HIERARCHY
+     (simlint SL1302's dynamic twin);
+  2. every non-poisoned job lands DONE with a digest BITWISE identical
+     to its own singleton run — tracing never perturbs results;
+  3. the traced locks actually traced (acquisition counts are live),
+     so gate 1 cannot pass vacuously.
+
+Artifacts in the out dir (uploaded by CI): ``race_summary.json`` and
+the flight-recorder dump ``flight_recorder_dump.jsonl``.
+
+Usage: python scripts/race_smoke.py [out_dir]   (default ./race_smoke)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# arm BEFORE the package imports: the whole fleet boots traced
+os.environ["WITT_LOCK_TRACE"] = "1"
+
+BASE = {"protocol": "PingPong", "params": {"node_ct": 32}, "simMs": 60}
+
+
+def storm(out_dir: str, failures: list) -> dict:
+    from wittgenstein_tpu.obs import FlightRecorder
+    from wittgenstein_tpu.runtime.locks import lock_trace_status
+    from wittgenstein_tpu.serve import BatchScheduler
+    from wittgenstein_tpu.serve.jobs import TERMINAL, JobState
+
+    recorder = FlightRecorder(
+        path=os.path.join(out_dir, "flight_recorder.jsonl")
+    )
+    sched = BatchScheduler(
+        auto_start=False, max_batch_replicas=4, recorder=recorder,
+        horizon_quantum_ms=0,
+    )
+    sched.start()
+
+    # -- submit storm: three threads race the admission path ----------
+    specs = [{**BASE, "seed": i} for i in range(9)]
+    jobs: list = [None] * len(specs)
+
+    def submitter(lo: int, hi: int) -> None:
+        for i in range(lo, hi):
+            jobs[i] = sched.submit(specs[i])
+
+    threads = [
+        threading.Thread(target=submitter, args=(k * 3, k * 3 + 3))
+        for k in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+
+    # -- failover mid-storm -------------------------------------------
+    sched.inject_lane_failure(0)
+
+    # -- chunked wave + drain/undrain interleave ----------------------
+    chunk_specs = [
+        {**BASE, "seed": 20 + i, "simMs": 200, "chunkMs": 50}
+        for i in range(3)
+    ]
+    chunk_jobs = [sched.submit(s) for s in chunk_specs]
+    time.sleep(0.2)  # let a slice park before draining
+    sched.drain()
+    time.sleep(0.2)  # lanes observe the drain under trace
+    sched.undrain()
+
+    pending = [j for j in jobs if j is not None] + chunk_jobs
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if all(j.state in TERMINAL for j in pending):
+            break
+        time.sleep(0.05)
+    sched.stop()
+
+    # -- gate 0: nothing lost -----------------------------------------
+    lost = [j.id for j in pending if j.state not in TERMINAL]
+    if lost or len(pending) != len(specs) + len(chunk_specs):
+        failures.append(f"storm lost jobs (non-terminal): {lost}")
+
+    # -- gate 1: zero lock-order violations ---------------------------
+    status = lock_trace_status()
+    violations = [
+        e for e in recorder.events() if e["kind"] == "lock-order-violation"
+    ]
+    if status["violationCount"] or violations:
+        failures.append(
+            f"lock-order violations: {status['violationCount']} in "
+            f"TracedLock state, {len(violations)} recorder events — "
+            f"{status['violations'][:3]}"
+        )
+
+    # -- gate 2: bitwise singleton identity ---------------------------
+    for j, s in zip(pending, specs + chunk_specs):
+        if j.state is not JobState.DONE:
+            failures.append(f"job {j.id} ended {j.state.value}: {j.error}")
+            continue
+        ref = sched.run_singleton(s)
+        if j.result["digest"] != ref["digest"]:
+            failures.append(
+                f"job {j.id} digest diverged from its singleton under "
+                "WITT_LOCK_TRACE=1"
+            )
+
+    # -- gate 3: the trace was live, not vacuous ----------------------
+    acq = sum(row["acquisitions"] for row in status["perLock"].values())
+    if not status["armed"] or acq == 0:
+        failures.append(
+            f"lock trace was not live (armed={status['armed']}, "
+            f"acquisitions={acq}) — gate 1 would be vacuous"
+        )
+    if sched.metrics.lane_restarts_total < 1:
+        failures.append("lane kill never restarted — failover untraced")
+
+    recorder.dump(os.path.join(out_dir, "flight_recorder_dump.jsonl"))
+    return {
+        "jobs": len(pending),
+        "laneRestarts": sched.metrics.lane_restarts_total,
+        "lockAcquisitions": acq,
+        "lockWaitMaxS": status["maxWaitS"],
+        "lockWaitP99S": status["waitP99S"],
+        "violations": status["violationCount"],
+    }
+
+
+def main() -> int:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "./race_smoke"
+    os.makedirs(out_dir, exist_ok=True)
+    failures: list = []
+    summary = storm(out_dir, failures)
+    print(f"race storm: {json.dumps(summary, sort_keys=True)}")
+    with open(os.path.join(out_dir, "race_summary.json"), "w") as f:
+        json.dump(
+            {"ok": not failures, "failures": failures, **summary},
+            f, indent=2, sort_keys=True,
+        )
+    if failures:
+        print("RACE SMOKE FAILED:")
+        for msg in failures:
+            print(f"  - {msg}")
+        return 1
+    print(f"race smoke OK — zero lock-order violations; artifacts in "
+          f"{out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

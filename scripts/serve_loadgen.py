@@ -73,8 +73,13 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
 from wittgenstein_tpu.parallel.replica_shard import run_cache_info  # noqa: E402
+from wittgenstein_tpu.runtime.locks import (  # noqa: E402
+    arm_lock_trace, lock_trace_status, reset_lock_trace,
+)
 from wittgenstein_tpu.serve import BatchScheduler, quantile  # noqa: E402
-from wittgenstein_tpu.server.ws import WServer, serve  # noqa: E402
+from wittgenstein_tpu.server.ws import (  # noqa: E402
+    WServer, serve, shutdown_server,
+)
 
 SIM_MS = 100
 BASE = {"protocol": "PingPong", "params": {"node_ct": 64}, "simMs": SIM_MS}
@@ -248,6 +253,29 @@ def fleet_bench(device_groups: int, per_family: int,
     witt-bench-serve record; appends to its own failure list."""
     failures = []
     specs = _fleet_specs(per_family)
+    # phase 0: a short ARMED probe — a slice of the workload runs under
+    # the lock trace so the record carries a lock-wait profile and a
+    # runtime lock-order audit.  Armed and disarmed (state reset) around
+    # the probe only: the timed serial/wave phases below stay untraced.
+    arm_lock_trace(True)
+    reset_lock_trace()
+    try:
+        _fleet_run(specs[: max(2, len(specs) // 4)], 1)
+        lt = lock_trace_status()
+    finally:
+        arm_lock_trace(False)
+        reset_lock_trace()
+    lock_trace = {
+        "armedProbe": True,
+        "lockWaitP99S": lt["waitP99S"],
+        "maxWaitS": lt["maxWaitS"],
+        "violationCount": lt["violationCount"],
+    }
+    if lt["violationCount"]:
+        failures.append(
+            f"lock-order violations under the armed fleet probe: "
+            f"{lt['violations'][:3]}"
+        )
     serial = _fleet_run(specs, 1)
     wave = _fleet_run(specs, device_groups)
     # correctness first: wave packing must not change a single byte
@@ -323,6 +351,7 @@ def fleet_bench(device_groups: int, per_family: int,
         "serial": serial,
         "wave": wave,
         "resilience": resilience,
+        "lockTrace": lock_trace,
         "speedup": round(speedup, 4),
         "minSpeedup": min_speedup,
         "speedupGateArmed": bool(min_speedup),
@@ -467,7 +496,7 @@ def main() -> int:
             f"SLO alerts fired during fault-free loadgen: "
             f"{alerts['by_slo']}"
         )
-    httpd.shutdown()
+    shutdown_server(httpd)
     ws.jobs.stop()
 
     lat = sorted(

@@ -1,8 +1,9 @@
 """simlint CI reporter: run every pass, always emit the JSONL artifact.
 
 Thin wrapper over `python -m wittgenstein_tpu.analysis` for CI: runs the
-same four passes (AST lint, registry coverage, abstract-eval contracts,
-beat RNG audit), writes one JSON object per finding to the output file
+same ten passes (AST lint, registry coverage, the abstract-eval
+contract tiers, beat RNG audit, SLO catalog, concurrency contract
+checker, ...), writes one JSON object per finding to the output file
 (plus a trailing summary record, so a clean run still produces a
 non-empty artifact a dashboard can ingest), prints the human-readable
 lines, and exits nonzero on any finding — CI treats simlint as strict.

@@ -104,6 +104,15 @@ def render_fleet(snap: dict) -> str:
             for i, l in enumerate(lanes)
         )
         lines.append(f"  {row}")
+    lt = h.get("lockTrace") or {}
+    if lt.get("armed"):
+        viol = int(lt.get("violationCount") or 0)
+        lines.append(
+            f"  lock trace: armed  waitMax={_fmt(lt.get('maxWaitS'))}s "
+            f"waitP99={_fmt(lt.get('waitP99S'))}s  "
+            + (f"LOCK-ORDER VIOLATIONS {viol} !!" if viol
+               else "violations 0")
+        )
     slo = snap.get("slo")
     if slo is None:
         lines.append("  /w/slo: not available on this server")
@@ -434,7 +443,9 @@ def main(argv=None) -> int:
         return 0
     finally:
         if httpd is not None:
-            httpd.shutdown()
+            from wittgenstein_tpu.server.ws import shutdown_server
+
+            shutdown_server(httpd)
         if ws is not None:
             ws.jobs.stop()
 

@@ -1,0 +1,181 @@
+"""Deterministic thread-interleaving harness for the serving fleet.
+
+The production hot paths carry named yield points
+(``runtime.locks.yield_point``, catalog in ``YIELD_POINTS``) that are
+one-global-read no-ops in normal runs.  Tests install an
+:class:`InterleaveController` (via ``runtime.locks.set_interleave``) to
+turn chosen points into rendezvous barriers: a thread reaching an ARMED
+point parks until the test releases it, so a specific cross-thread
+schedule — e.g. "both workers observe the run-cache miss BEFORE either
+takes the compile lock" — is forced deterministically instead of hoped
+for with sleeps.
+
+The controller is deliberately tiny and deadlock-safe:
+
+* only points named in ``arm()`` ever block; every other yield point
+  stays a no-op, so unrelated fleet machinery (lane loops, health
+  polls) never parks;
+* each armed point blocks at most ``max_holds`` threads and every park
+  carries a hard timeout — a schedule bug fails the test instead of
+  hanging the suite;
+* ``close()`` (or the context manager exit) releases everything and
+  restores the no-op, even when the test body raises.
+
+Typical use (the PR-11 duplicate-compile schedule)::
+
+    with InterleaveController() as ctl:
+        ctl.arm("runcache.lookup-miss", holds=2)
+        t1.start(); t2.start()                 # both park on the miss
+        ctl.wait_parked("runcache.lookup-miss", 2)
+        ctl.release("runcache.lookup-miss")    # race through the lock
+        t1.join(); t2.join()
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from wittgenstein_tpu.runtime.locks import set_interleave
+
+__all__ = ["InterleaveController", "Interleaved"]
+
+_DEFAULT_TIMEOUT_S = 30.0
+
+
+class _Point:
+    def __init__(self, holds: int):
+        self.holds = holds  # how many arrivals to park before no-op
+        self.parked = 0
+        self.passed = 0
+        self.released = False
+        self.cond = threading.Condition()
+
+
+class InterleaveController:
+    """Armed yield points become rendezvous barriers; everything else
+    stays a no-op.  One controller per test; always close it."""
+
+    def __init__(self, timeout_s: float = _DEFAULT_TIMEOUT_S):
+        self.timeout_s = timeout_s
+        self._points: Dict[str, _Point] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self.trace: List[str] = []  # arrival order, for assertions
+
+    # -- wiring ---------------------------------------------------------
+    def install(self) -> "InterleaveController":
+        set_interleave(self._on_yield)
+        return self
+
+    def close(self) -> None:
+        """Release every parked thread and restore the no-op."""
+        self._closed = True
+        set_interleave(None)
+        with self._lock:
+            points = list(self._points.values())
+        for p in points:
+            with p.cond:
+                p.released = True
+                p.cond.notify_all()
+
+    def __enter__(self) -> "InterleaveController":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- test API -------------------------------------------------------
+    def arm(self, name: str, holds: int = 1) -> None:
+        """Park the next ``holds`` threads that reach ``name``."""
+        with self._lock:
+            self._points[name] = _Point(holds)
+
+    def release(self, name: str) -> None:
+        """Unpark everything held at ``name`` (and stop parking there)."""
+        with self._lock:
+            p = self._points.get(name)
+        if p is None:
+            return
+        with p.cond:
+            p.released = True
+            p.cond.notify_all()
+
+    def wait_parked(self, name: str, n: int,
+                    timeout_s: Optional[float] = None) -> None:
+        """Block until ``n`` threads are parked at ``name`` — the
+        test-side half of the rendezvous."""
+        deadline = timeout_s if timeout_s is not None else self.timeout_s
+        with self._lock:
+            p = self._points.get(name)
+        if p is None:
+            raise AssertionError(f"yield point {name!r} was never armed")
+        with p.cond:
+            if not p.cond.wait_for(
+                lambda: p.parked >= n or p.released, timeout=deadline
+            ):
+                raise AssertionError(
+                    f"interleave: waited {deadline}s for {n} thread(s) at "
+                    f"{name!r}, saw {p.parked}"
+                )
+
+    def arrivals(self, name: str) -> int:
+        with self._lock:
+            p = self._points.get(name)
+        return p.passed if p is not None else 0
+
+    # -- the hook production code calls ---------------------------------
+    def _on_yield(self, name: str) -> None:
+        if self._closed:
+            return
+        self.trace.append(name)
+        with self._lock:
+            p = self._points.get(name)
+        if p is None:
+            return
+        with p.cond:
+            p.passed += 1
+            if p.released or p.parked >= p.holds:
+                return
+            p.parked += 1
+            p.cond.notify_all()  # wake wait_parked watchers
+            if not p.cond.wait_for(
+                lambda: p.released, timeout=self.timeout_s
+            ):
+                raise AssertionError(
+                    f"interleave: parked {self.timeout_s}s at {name!r} "
+                    "without release — schedule bug in the test"
+                )
+
+
+class Interleaved:
+    """Run callables on named threads and re-raise the first failure —
+    the thread-herding boilerplate every interleaving test needs."""
+
+    def __init__(self):
+        self._threads: List[threading.Thread] = []
+        self._errors: List[BaseException] = []
+        self._err_lock = threading.Lock()
+        self.results: Dict[str, object] = {}
+
+    def spawn(self, name: str, fn, *args, **kwargs) -> threading.Thread:
+        def body():
+            try:
+                self.results[name] = fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — re-raised in join_all
+                with self._err_lock:
+                    self._errors.append(e)
+
+        t = threading.Thread(target=body, name=name, daemon=True)
+        self._threads.append(t)
+        t.start()
+        return t
+
+    def join_all(self, timeout_s: float = _DEFAULT_TIMEOUT_S) -> None:
+        for t in self._threads:
+            t.join(timeout=timeout_s)
+        alive = [t.name for t in self._threads if t.is_alive()]
+        if alive:
+            raise AssertionError(f"threads still running: {alive}")
+        if self._errors:
+            raise self._errors[0]

@@ -1,0 +1,531 @@
+"""Concurrency contract checker (simlint pass 10) + TracedLock + the
+deterministic interleaving harness.
+
+Three layers, mirroring the shipped defect classes they guard:
+
+* **Rule liveness** — every SL1301-SL1305 rule proven on a crafted bad
+  fixture (including a cross-function lock inversion and an unjoined
+  worker), plus SL1306/SL1307 registry/catalog drift, plus the escape
+  hatches (``UNGUARDED_OK``, ``# simlint: disable=``).
+* **Whole-tree clean** — the real tree passes pass 10 with zero
+  findings (the CI gate's in-suite twin).
+* **Dynamics** — TracedLock detects inversions at runtime and is
+  bitwise-neutral across three protocols; the interleaving harness
+  REPRODUCES the PR-11 duplicate-compile race on a deliberately
+  reverted guard and proves the current double-checked lock immune.
+"""
+
+import os
+import threading
+
+import pytest
+
+from tests.interleave import InterleaveController, Interleaved
+from wittgenstein_tpu.analysis.concurrency_check import (
+    LockRegistry,
+    check_concurrency,
+    check_files,
+    load_registry,
+)
+from wittgenstein_tpu.analysis.findings import RULES
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a tiny two-lock hierarchy for the bad fixtures: "outer" (rank 0,
+# dispatch-class) must be taken before "inner" (rank 1)
+REG = LockRegistry(
+    ranks={"outer": 0, "inner": 1},
+    sites={
+        "serve/w.py::Widget._outer": "outer",
+        "serve/w.py::Widget._inner": "inner",
+    },
+    no_blocking=frozenset({"outer"}),
+    yield_points=("p.one",),
+)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _check(src: str, registry=REG, path="serve/w.py"):
+    return check_files({path: src}, registry)
+
+
+class TestRuleLiveness:
+    def test_sl1301_undeclared_lock(self):
+        fs = _check(
+            "import threading\n"
+            "class Widget:\n"
+            "    def __init__(self):\n"
+            "        self._outer = threading.Lock()\n"
+            "        self._rogue = threading.Lock()\n"
+        )
+        assert _rules(fs) == ["SL1301", "SL1306"]  # inner site now stale
+        assert any("_rogue" in f.message for f in fs if f.rule == "SL1301")
+
+    def test_sl1301_unregistered_traced_name(self):
+        fs = _check(
+            "class Widget:\n"
+            "    def __init__(self):\n"
+            "        self._outer = make_lock('no-such-lock')\n"
+        )
+        assert "SL1301" in _rules(fs)
+
+    def test_sl1302_direct_inversion(self):
+        fs = _check(
+            "import threading\n"
+            "class Widget:\n"
+            "    def __init__(self):\n"
+            "        self._outer = threading.Lock()\n"
+            "        self._inner = threading.Lock()\n"
+            "    def bad(self):\n"
+            "        with self._inner:\n"
+            "            with self._outer:\n"
+            "                pass\n"
+        )
+        assert "SL1302" in _rules(fs)
+
+    def test_sl1302_cross_function_inversion(self):
+        # the crafted two-function inversion: bad() holds 'inner' and
+        # calls helper(), which acquires 'outer' — only call-graph
+        # inference can see the descending edge
+        fs = _check(
+            "import threading\n"
+            "class Widget:\n"
+            "    def __init__(self):\n"
+            "        self._outer = threading.Lock()\n"
+            "        self._inner = threading.Lock()\n"
+            "    def helper(self):\n"
+            "        with self._outer:\n"
+            "            pass\n"
+            "    def bad(self):\n"
+            "        with self._inner:\n"
+            "            self.helper()\n"
+        )
+        hits = [f for f in fs if f.rule == "SL1302"]
+        assert hits and "helper" in hits[0].message
+
+    def test_sl1302_clean_ascending_order_passes(self):
+        fs = _check(
+            "import threading\n"
+            "class Widget:\n"
+            "    def __init__(self):\n"
+            "        self._outer = threading.Lock()\n"
+            "        self._inner = threading.Lock()\n"
+            "    def good(self):\n"
+            "        with self._outer:\n"
+            "            with self._inner:\n"
+            "                pass\n"
+        )
+        assert "SL1302" not in _rules(fs)
+
+    def test_sl1303_blocking_under_dispatch_lock(self):
+        fs = _check(
+            "import threading, time\n"
+            "class Widget:\n"
+            "    def __init__(self):\n"
+            "        self._outer = threading.Lock()\n"
+            "        self._inner = threading.Lock()\n"
+            "    def bad(self):\n"
+            "        with self._outer:\n"
+            "            time.sleep(1)\n"
+        )
+        assert "SL1303" in _rules(fs)
+
+    def test_sl1303_transitive_compile_and_timeoutless_get(self):
+        fs = _check(
+            "import threading\n"
+            "class Widget:\n"
+            "    def __init__(self):\n"
+            "        self._outer = threading.Lock()\n"
+            "        self._inner = threading.Lock()\n"
+            "        self.q = None\n"
+            "    def compiles(self, jit, states):\n"
+            "        return jit.lower(states).compile()\n"
+            "    def bad(self, jit, states):\n"
+            "        with self._outer:\n"
+            "            self.compiles(jit, states)\n"
+            "    def also_bad(self):\n"
+            "        with self._outer:\n"
+            "            return self.q.get()\n"
+        )
+        hits = [f for f in fs if f.rule == "SL1303"]
+        assert len(hits) >= 2  # the reached compile AND the bare get()
+
+    def test_sl1303_blocking_under_ordinary_lock_is_fine(self):
+        fs = _check(
+            "import threading, time\n"
+            "class Widget:\n"
+            "    def __init__(self):\n"
+            "        self._outer = threading.Lock()\n"
+            "        self._inner = threading.Lock()\n"
+            "    def ok(self):\n"
+            "        with self._inner:\n"  # not no_blocking
+            "            time.sleep(1)\n"
+        )
+        assert "SL1303" not in _rules(fs)
+
+    def test_sl1304_unjoined_worker(self):
+        fs = _check(
+            "import threading\n"
+            "class Worker:\n"
+            "    def start(self):\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "        self._t.start()\n"
+            "    def _loop(self):\n"
+            "        while True:\n"
+            "            pass\n",
+            registry=LockRegistry.empty(),
+        )
+        msgs = [f.message for f in fs if f.rule == "SL1304"]
+        assert any("neither daemon" in m for m in msgs)
+        assert any("no shutdown path" in m for m in msgs)
+
+    def test_sl1304_daemon_plus_stop_event_passes(self):
+        fs = _check(
+            "import threading\n"
+            "class Worker:\n"
+            "    def start(self):\n"
+            "        self._stop = threading.Event()\n"
+            "        self._t = threading.Thread(\n"
+            "            target=self._loop, daemon=True)\n"
+            "        self._t.start()\n"
+            "    def stop(self):\n"
+            "        self._stop.set()\n"
+            "        self._t.join()\n"
+            "    def _loop(self):\n"
+            "        while not self._stop.is_set():\n"
+            "            pass\n",
+            registry=LockRegistry.empty(),
+        )
+        assert "SL1304" not in _rules(fs)
+
+    def test_sl1304_stop_event_nobody_sets(self):
+        fs = _check(
+            "import threading\n"
+            "class Worker:\n"
+            "    def start(self):\n"
+            "        self._stop = threading.Event()\n"
+            "        self._t = threading.Thread(\n"
+            "            target=self._loop, daemon=True)\n"
+            "        self._t.start()\n"
+            "    def _loop(self):\n"
+            "        while not self._stop.is_set():\n"
+            "            pass\n",
+            registry=LockRegistry.empty(),
+        )
+        assert any(
+            "set()" in f.message for f in fs if f.rule == "SL1304"
+        )
+
+    def test_sl1305_unguarded_write_in_spawning_class(self):
+        fs = _check(
+            "import threading\n"
+            "class Worker:\n"
+            "    def start(self):\n"
+            "        self._t = threading.Thread(\n"
+            "            target=self._loop, daemon=True)\n"
+            "        self._t.start()\n"
+            "    def _loop(self):\n"
+            "        self.count = 1\n"
+            "        return\n",
+            registry=LockRegistry.empty(),
+        )
+        assert any(
+            "count" in f.message for f in fs if f.rule == "SL1305"
+        )
+
+    def test_sl1305_guarded_write_and_escape_hatches(self):
+        # guarded write passes; UNGUARDED_OK and a line suppression
+        # silence the two documented single-writer fields
+        fs = _check(
+            "import threading\n"
+            "class Widget:\n"
+            "    UNGUARDED_OK = ('stat',)\n"
+            "    def __init__(self):\n"
+            "        self._outer = threading.Lock()\n"
+            "        self._inner = threading.Lock()\n"
+            "    def ok(self):\n"
+            "        with self._inner:\n"
+            "            self.value = 1\n"
+            "        self.stat = 2\n"
+            "        self.other = 3  # simlint: disable=SL1305\n"
+        )
+        assert "SL1305" not in _rules(fs)
+
+    def test_sl1305_inconsistent_guards(self):
+        fs = _check(
+            "import threading\n"
+            "class Widget:\n"
+            "    def __init__(self):\n"
+            "        self._outer = threading.Lock()\n"
+            "        self._inner = threading.Lock()\n"
+            "    def a(self):\n"
+            "        with self._inner:\n"
+            "            self.value = 1\n"
+            "    def b(self):\n"
+            "        with self._outer:\n"
+            "            self.value = 2\n"
+        )
+        assert any(
+            "different locks" in f.message for f in fs if f.rule == "SL1305"
+        )
+
+    def test_sl1306_stale_registry_site(self):
+        fs = _check(
+            "import threading\n"
+            "class Widget:\n"
+            "    def __init__(self):\n"
+            "        self._outer = threading.Lock()\n"
+        )  # the declared _inner site is never constructed
+        assert any(
+            "inner" in f.message for f in fs if f.rule == "SL1306"
+        )
+
+    def test_sl1307_yield_point_drift_both_directions(self):
+        fs = _check(
+            "import threading\n"
+            "class Widget:\n"
+            "    def __init__(self):\n"
+            "        self._outer = threading.Lock()\n"
+            "        self._inner = threading.Lock()\n"
+            "    def run(self):\n"
+            "        yield_point('p.unknown')\n"
+        )
+        msgs = [f.message for f in fs if f.rule == "SL1307"]
+        assert any("p.unknown" in m for m in msgs)  # uncataloged site
+        assert any("p.one" in m for m in msgs)  # cataloged, no site
+
+    def test_rules_registered_in_catalog(self):
+        for rule in ("SL1301", "SL1302", "SL1303", "SL1304", "SL1305",
+                     "SL1306", "SL1307"):
+            assert rule in RULES
+
+
+class TestWholeTree:
+    def test_registry_loads_and_is_total_order(self):
+        reg = load_registry(
+            os.path.join(REPO_ROOT, "wittgenstein_tpu", "runtime",
+                         "locks.py")
+        )
+        assert len(reg.ranks) >= 15
+        assert sorted(reg.ranks.values()) == list(range(len(reg.ranks)))
+        for site, name in reg.sites.items():
+            assert name in reg.ranks
+            assert "::" in site and "." in site.split("::", 1)[1]
+        assert reg.no_blocking <= set(reg.ranks)
+        assert len(reg.yield_points) == len(set(reg.yield_points)) >= 8
+
+    def test_tree_is_clean(self):
+        findings = check_concurrency(REPO_ROOT)
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+
+class TestTracedLockRuntime:
+    def setup_method(self):
+        from wittgenstein_tpu.runtime.locks import (
+            arm_lock_trace, reset_lock_trace,
+        )
+        arm_lock_trace(True)
+        reset_lock_trace()
+
+    def teardown_method(self):
+        from wittgenstein_tpu.runtime.locks import (
+            arm_lock_trace, reset_lock_trace,
+        )
+        arm_lock_trace(False)
+        reset_lock_trace()
+
+    def test_rank_inversion_detected_and_recorded(self):
+        from wittgenstein_tpu.obs.recorder import get_recorder
+        from wittgenstein_tpu.runtime.locks import (
+            lock_trace_status, make_lock,
+        )
+        lo = make_lock("serve.dispatch")
+        hi = make_lock("serve.queue")
+        with lo:
+            with hi:
+                pass  # ascending: fine
+        assert lock_trace_status()["violationCount"] == 0
+        with hi:
+            with lo:
+                pass  # descending: the audit fires
+        st = lock_trace_status()
+        assert st["violationCount"] == 1
+        v = st["violations"][0]
+        assert (v["held"], v["acquiring"]) == ("serve.queue",
+                                               "serve.dispatch")
+        evs = [e for e in get_recorder().events()
+               if e["kind"] == "lock-order-violation"]
+        assert evs and evs[-1]["acquiring"] == "serve.dispatch"
+
+    def test_violation_deduped_per_pair(self):
+        from wittgenstein_tpu.runtime.locks import (
+            lock_trace_status, make_lock,
+        )
+        lo = make_lock("serve.dispatch")
+        hi = make_lock("serve.queue")
+        for _ in range(3):
+            with hi:
+                with lo:
+                    pass
+        assert lock_trace_status()["violationCount"] == 1
+
+    def test_wait_metrics_accumulate(self):
+        from wittgenstein_tpu.runtime.locks import (
+            lock_trace_status, make_lock,
+        )
+        lk = make_lock("serve.metrics")
+        for _ in range(5):
+            with lk:
+                pass
+        row = lock_trace_status()["perLock"]["serve.metrics"]
+        assert row["acquisitions"] == 5
+        assert row["waitSecondsTotal"] >= 0.0
+
+    def test_unregistered_name_raises(self):
+        from wittgenstein_tpu.runtime.locks import TracedLock
+        with pytest.raises(ValueError):
+            TracedLock("not-a-registered-lock")
+
+    def test_unarmed_does_no_bookkeeping(self):
+        from wittgenstein_tpu.runtime.locks import (
+            arm_lock_trace, lock_trace_status, make_lock, reset_lock_trace,
+        )
+        arm_lock_trace(False)
+        reset_lock_trace()
+        lo = make_lock("serve.dispatch")
+        hi = make_lock("serve.queue")
+        with hi:
+            with lo:
+                pass  # inverted, but the trace is off: zero cost, zero state
+        st = lock_trace_status()
+        assert st["violationCount"] == 0 and st["perLock"] == {}
+
+
+SPECS = {
+    "PingPong": {"protocol": "PingPong", "params": {"node_ct": 32},
+                 "simMs": 40},
+    "P2PFlood": {"protocol": "P2PFlood",
+                 "params": {"node_count": 32, "msg_count": 2,
+                            "msg_to_receive": 2, "peers_count": 3},
+                 "simMs": 40},
+    "Handel": {"protocol": "Handel", "params": {}, "simMs": 40},
+}
+
+
+class TestTraceNeutrality:
+    @pytest.mark.parametrize("name", sorted(SPECS))
+    def test_armed_trace_is_bitwise_neutral(self, name):
+        """The whole point of zero-cost-when-off AND safe-when-on: a
+        traced singleton run produces the bit-identical digest."""
+        from wittgenstein_tpu.parallel.replica_shard import clear_run_cache
+        from wittgenstein_tpu.runtime.locks import (
+            arm_lock_trace, lock_trace_status, reset_lock_trace,
+        )
+        from wittgenstein_tpu.serve import BatchScheduler
+
+        sched = BatchScheduler(auto_start=False)
+        spec = SPECS[name]
+        arm_lock_trace(False)
+        reset_lock_trace()
+        off = sched.run_singleton(spec)["digest"]
+        clear_run_cache()  # force the armed run through the full path
+        arm_lock_trace(True)
+        reset_lock_trace()
+        try:
+            on = sched.run_singleton(spec)["digest"]
+            st = lock_trace_status()
+            assert st["violationCount"] == 0
+            assert st["perLock"], "armed run traced no locks"
+        finally:
+            arm_lock_trace(False)
+            reset_lock_trace()
+        assert on == off
+
+
+class TestInterleaveHarness:
+    def _entry(self, sim_ms):
+        from wittgenstein_tpu.core.registries import (
+            registry_batched_protocols,
+        )
+        from wittgenstein_tpu.engine import replicate_state
+        from wittgenstein_tpu.parallel import replica_shard as rs
+
+        net, state = registry_batched_protocols.get("pingpong").factory()
+        states = replicate_state(state, 2)
+        return rs._run_and_reduce(net, sim_ms), states
+
+    def _race_once(self, sim_ms):
+        """Force the PR-11 schedule: both threads observe the run-cache
+        miss BEFORE either takes the compile lock; returns the number
+        of compiles the stampede cost."""
+        from wittgenstein_tpu.parallel import replica_shard as rs
+
+        entry, states = self._entry(sim_ms)
+        before = rs.run_cache_info()["compiles"]
+        with InterleaveController() as ctl:
+            ctl.arm("runcache.lookup-miss", holds=2)
+            herd = Interleaved()
+            herd.spawn("a", entry, states)
+            herd.spawn("b", entry, states)
+            ctl.wait_parked("runcache.lookup-miss", 2)
+            ctl.release("runcache.lookup-miss")
+            herd.join_all(timeout_s=300)
+        import jax
+        import numpy as np
+
+        a_out = jax.tree_util.tree_leaves(herd.results["a"])
+        b_out = jax.tree_util.tree_leaves(herd.results["b"])
+        for x, y in zip(a_out, b_out):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        return rs.run_cache_info()["compiles"] - before
+
+    def test_current_guard_is_race_immune(self):
+        # both threads forced through the miss window: the locked
+        # recheck holds the compile to a true singleton
+        assert self._race_once(23) == 1
+
+    def test_reverted_guard_reproduces_pr11_race(self):
+        # delete the recheck (the exact pre-PR-11 code shape) and the
+        # SAME forced schedule duplicates the compile — the regression
+        # test that would have caught it
+        from wittgenstein_tpu.parallel import replica_shard as rs
+
+        rs._RECHECK_UNDER_LOCK = False
+        try:
+            assert self._race_once(29) == 2
+        finally:
+            rs._RECHECK_UNDER_LOCK = True
+
+    def test_scheduler_claim_dispatch_gating(self):
+        """Interleaving sweep over the serve path: park the lane at
+        claim, then at dispatch, release, and require bitwise singleton
+        results — the yield points gate REAL schedules."""
+        from wittgenstein_tpu.serve import BatchScheduler
+        from wittgenstein_tpu.serve.jobs import TERMINAL
+
+        spec = SPECS["PingPong"]
+        for point in ("serve.claim", "serve.dispatch"):
+            sched = BatchScheduler(auto_start=False,
+                                   max_batch_replicas=4)
+            with InterleaveController() as ctl:
+                ctl.arm(point, holds=1)
+                sched.start()
+                job = sched.submit({**spec, "seed": 7})
+                ctl.wait_parked(point, 1)
+                assert job.state not in TERMINAL or point == "serve.claim"
+                ctl.release(point)
+                assert job.done_event.wait(300)
+            ref = sched.run_singleton({**spec, "seed": 7})
+            assert job.result["digest"] == ref["digest"], point
+            sched.stop()
+
+    def test_controller_restores_noop_on_close(self):
+        from wittgenstein_tpu.runtime import locks
+
+        with InterleaveController() as ctl:
+            ctl.arm("store.get", holds=1)
+        assert locks._interleave is None
+        locks.yield_point("store.get")  # must be a no-op again

@@ -23,7 +23,7 @@ from wittgenstein_tpu.serve import (
     JobQueue,
     JobState,
 )
-from wittgenstein_tpu.server.ws import WServer, serve
+from wittgenstein_tpu.server.ws import WServer, serve, shutdown_server
 
 BASE = {"protocol": "PingPong", "params": {"node_ct": 32}, "simMs": 60}
 
@@ -53,7 +53,7 @@ def ws():
 def base_url(ws):
     httpd = serve(0, ws=ws)
     yield f"http://127.0.0.1:{httpd.server_address[1]}"
-    httpd.shutdown()
+    shutdown_server(httpd)
     ws.jobs.stop()
 
 

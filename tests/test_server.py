@@ -7,7 +7,7 @@ import urllib.request
 
 import pytest
 
-from wittgenstein_tpu.server import WServer, serve
+from wittgenstein_tpu.server import WServer, serve, shutdown_server
 
 
 @pytest.fixture(scope="module")
@@ -15,7 +15,7 @@ def base_url():
     httpd = serve(0)
     port = httpd.server_address[1]
     yield f"http://127.0.0.1:{port}"
-    httpd.shutdown()
+    shutdown_server(httpd)
 
 
 def get(base, path):

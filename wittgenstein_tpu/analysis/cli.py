@@ -1,23 +1,25 @@
 """simlint command line: `python -m wittgenstein_tpu.analysis [opts]`.
 
-Runs up to nine passes and prints findings as `path:line: RULE [sev] msg`
+Runs up to ten passes and prints findings as `path:line: RULE [sev] msg`
 (or JSONL with --format json):
 
   1. AST lint over every wittgenstein_tpu/*.py  (SL1xx/SL2xx)
   2. registry/test coverage meta-rule           (SL301)
   3. SLO alert catalog audit                    (SL1101)
-  4. abstract-eval contract checks              (SL401-SL404)
-  5. beat RNG audit                             (SL405)
-  6. checkpoint completeness                    (SL501)
-  7. phase-annotation presence + neutrality     (SL601)
-  8. serve scheduler batching contract          (SL801)
-  9. 2D-mesh replicated-leaf audit              (SL1001)
+  4. concurrency contract checker               (SL1301-SL1307)
+  5. abstract-eval contract checks              (SL401-SL404)
+  6. beat RNG audit                             (SL405)
+  7. checkpoint completeness                    (SL501)
+  8. phase-annotation presence + neutrality     (SL601)
+  9. serve scheduler batching contract          (SL801)
+ 10. 2D-mesh replicated-leaf audit              (SL1001)
 
 Exit status: 0 when clean; 1 when any ERROR finding (or, with --strict,
-any finding at all) survives suppression; 2 on usage errors.  Passes 4-8
+any finding at all) survives suppression; 2 on usage errors.  Passes 5-9
 build every registered protocol and trace real kernels, so they take tens
 of seconds — `--skip-contracts` runs just the fast text-level passes
-(1-3; no JAX import).
+(1-4; no JAX import); `--skip-concurrency` drops the lock-discipline
+pass from either mode.
 """
 
 from __future__ import annotations
@@ -47,6 +49,9 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--skip-contracts", action="store_true",
                    help="skip the abstract-eval + RNG passes (AST and "
                    "registry rules only; no JAX import)")
+    p.add_argument("--skip-concurrency", action="store_true",
+                   help="skip the concurrency contract checker "
+                   "(SL1301-SL1307)")
     p.add_argument("--protocol", action="append", default=None,
                    metavar="NAME",
                    help="restrict contract/RNG passes to this registered "
@@ -63,7 +68,8 @@ def _rel(path: str, root: str) -> str:
 
 
 def run(root: str, skip_contracts: bool = False,
-        protocols: Optional[List[str]] = None) -> List[Finding]:
+        protocols: Optional[List[str]] = None,
+        skip_concurrency: bool = False) -> List[Finding]:
     """All passes over `root`; returns the surviving findings."""
     import dataclasses
 
@@ -77,6 +83,10 @@ def run(root: str, skip_contracts: bool = False,
     from .slo_check import check_slo_catalog
 
     findings += check_slo_catalog(root)
+    if not skip_concurrency:
+        from .concurrency_check import check_concurrency
+
+        findings += check_concurrency(root)
     findings = [
         dataclasses.replace(f, path=_rel(f.path, root)) for f in findings
     ]
@@ -124,7 +134,8 @@ def main(argv=None) -> int:
         return 2
 
     findings = run(root, skip_contracts=args.skip_contracts,
-                   protocols=args.protocol)
+                   protocols=args.protocol,
+                   skip_concurrency=args.skip_concurrency)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
 
     lines = [

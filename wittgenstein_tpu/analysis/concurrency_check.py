@@ -1,0 +1,1185 @@
+"""simlint pass 10: the concurrency contract checker (SL1301-SL1307).
+
+A pure-AST audit of the HOST-side tree (serve/, runtime/, obs/,
+server/, parallel/, telemetry/) against the lock registry declared in
+``runtime/locks.py`` — the concurrency dual of the kernel-side passes:
+the fleet's locks, threads, and shared attributes are contracts, and
+contracts get checkers.
+
+Rules:
+
+* **SL1301** — undeclared lock.  Every ``threading.Lock/RLock/
+  Condition`` construction must anchor to a registry site
+  (``relpath::Class.attr`` / ``relpath::GLOBAL.name``), and every
+  ``make_lock``/``TracedLock`` name must be registered.
+* **SL1302** — lock-order inversion (the deadlock-order audit).  With a
+  TOTAL order over named locks, deadlock needs a descending edge
+  somewhere; this rule finds acquisition chains — direct or across
+  function boundaries via call-graph inference — that take a lock at or
+  below the rank of one already held.  The inference is a deliberate
+  under-approximation (only unambiguously resolvable calls contribute),
+  so every report is a real descending edge.
+* **SL1303** — blocking work under a dispatch-class lock
+  (``no_blocking`` in the registry): ``.lower(...).compile()``,
+  ``block_until_ready``, file I/O, HTTP, ``time.sleep``, timeout-less
+  ``get()/wait()/join()``.  The PR-11 race's dual: that fix moved
+  compiles OUTSIDE ``_dispatch_lock``; this rule keeps them out.
+* **SL1304** — thread lifecycle (the PR-12 leak class).  Every spawned
+  ``threading.Thread`` must be daemonized or joined, and a resolvable
+  worker loop must have a shutdown path: a loop exit (``return``/
+  ``break``) or a stop-event whose ``.set()`` some method calls.
+* **SL1305** — unguarded shared write.  In classes that spawn threads
+  or own registered locks, every attribute written outside ``__init__``
+  must be written under the SAME named lock at every write site —
+  lexically, via an all-call-sites-hold-the-lock caller contract, or
+  via a ``@route``-style locked-dispatch decorator — unless listed in
+  the class's ``UNGUARDED_OK`` tuple (documented single-writer fields)
+  or line-suppressed.
+* **SL1306** — stale registry: a declared site matching no live
+  construction.
+* **SL1307** — yield-point drift: ``yield_point()`` call sites and the
+  ``YIELD_POINTS`` catalog must agree in both directions.
+
+``check_concurrency(root)`` audits a real tree; ``check_files`` takes a
+``{relpath: source}`` dict plus an explicit registry so tests can prove
+each rule live on crafted bad fixtures.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import importlib.util
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .findings import Finding, apply_suppressions
+
+#: host-side packages pass 10 audits (kernel code has its own passes)
+HOST_DIRS = ("serve", "runtime", "obs", "server", "parallel", "telemetry")
+#: the registry itself is the declaration channel, not a subject
+EXEMPT = ("runtime/locks.py",)
+
+_LOCK_CTORS = ("Lock", "RLock")
+_TRACED_CTORS = ("make_lock", "TracedLock")
+#: attribute calls that block by nature (``.lower`` only with args —
+#: ``str.lower()`` takes none, ``jit.lower(states)`` does not)
+_BLOCKING_ATTRS = ("compile", "block_until_ready", "urlopen")
+#: zero-arg forms of these block without a timeout
+_TIMEOUTLESS_ATTRS = ("get", "wait", "join")
+
+
+@dataclasses.dataclass(frozen=True)
+class LockRegistry:
+    """What the checker needs from runtime/locks.py."""
+
+    ranks: Dict[str, int]
+    sites: Dict[str, str]  # site string -> lock name
+    no_blocking: frozenset
+    yield_points: Tuple[str, ...]
+
+    @classmethod
+    def empty(cls) -> "LockRegistry":
+        return cls({}, {}, frozenset(), ())
+
+
+def load_registry(locks_path: str) -> LockRegistry:
+    """Load the registry by executing runtime/locks.py STANDALONE
+    (stdlib-only by contract) — no package import, so the fast simlint
+    passes stay jax-free."""
+    if not os.path.isfile(locks_path):
+        return LockRegistry.empty()
+    spec = importlib.util.spec_from_file_location(
+        "_witt_locks_registry", locks_path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves field types through sys.modules[__module__],
+    # so the standalone module must be registered while it executes
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(spec.name, None)
+    ranks, sites, no_blocking = {}, {}, set()
+    for rank, row in enumerate(mod.LOCK_HIERARCHY):
+        ranks[row.name] = rank
+        for site in row.sites:
+            sites[site] = row.name
+        if row.no_blocking:
+            no_blocking.add(row.name)
+    return LockRegistry(
+        ranks, sites, frozenset(no_blocking),
+        tuple(getattr(mod, "YIELD_POINTS", ())),
+    )
+
+
+# -- per-file model -----------------------------------------------------------
+@dataclasses.dataclass
+class FuncInfo:
+    path: str
+    class_name: Optional[str]
+    name: str
+    node: ast.AST
+    decorators: List[ast.expr]
+    # (lock name, line, held lock names at acquisition)
+    acquires: List[Tuple[str, int, Tuple[str, ...]]] = dataclasses.field(
+        default_factory=list
+    )
+    # (call ref, line, held lock names at call)
+    calls: List[Tuple[tuple, int, Tuple[str, ...]]] = dataclasses.field(
+        default_factory=list
+    )
+    # (description, line, held lock names)
+    blocking: List[Tuple[str, int, Tuple[str, ...]]] = dataclasses.field(
+        default_factory=list
+    )
+    # (attr, line, held lock names at write)
+    writes: List[Tuple[str, int, Tuple[str, ...]]] = dataclasses.field(
+        default_factory=list
+    )
+
+    @property
+    def qualname(self) -> str:
+        return (
+            f"{self.class_name}.{self.name}" if self.class_name else self.name
+        )
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    path: str
+    name: str
+    node: ast.ClassDef
+    methods: Dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    #: attr -> registered lock name (self.x = make_lock(...)/threading.Lock()
+    #: whose site is declared)
+    attr_locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: attr -> aliased lock attr (self._work = threading.Condition(self._lock))
+    cond_aliases: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: attr -> class name (from __init__ constructor calls / annotated
+    #: factory returns) for call-graph resolution
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+    unguarded_ok: Tuple[str, ...] = ()
+    spawns_thread: bool = False
+
+
+@dataclasses.dataclass
+class FileInfo:
+    path: str
+    source: str
+    tree: ast.Module
+    classes: Dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+    functions: Dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    #: module-global var -> registered lock name
+    global_locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: every construction's site string (for SL1306 liveness)
+    constructed_sites: List[str] = dataclasses.field(default_factory=list)
+    #: (line, message) undeclared-lock findings
+    sl1301: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
+    #: threading.Thread spawn records
+    spawns: List[dict] = dataclasses.field(default_factory=list)
+    #: terminal names seen in ``<...>.join(...)`` calls
+    join_targets: set = dataclasses.field(default_factory=set)
+    #: (name literal or None, line) of yield_point() calls
+    yield_calls: List[Tuple[Optional[str], int]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+def _is_threading_ctor(call: ast.Call, names: Sequence[str]) -> Optional[str]:
+    f = call.func
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "threading"
+        and f.attr in names
+    ):
+        return f.attr
+    return None
+
+
+def _is_traced_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in _TRACED_CTORS:
+        return True
+    return isinstance(f, ast.Attribute) and f.attr in _TRACED_CTORS
+
+
+def _str_const(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _ctor_class_name(value: ast.expr) -> Optional[str]:
+    """The class a constructor-ish RHS produces: ``C(...)``, ``x or
+    C(...)``, or a call to an annotated factory (resolved later)."""
+    if isinstance(value, ast.BoolOp):
+        for v in value.values:
+            got = _ctor_class_name(v)
+            if got:
+                return got
+        return None
+    if isinstance(value, ast.Call) and isinstance(value.func, ast.Name):
+        name = value.func.id
+        if name and name[0].isupper():
+            return name
+    return None
+
+
+def _factory_call_name(value: ast.expr) -> Optional[str]:
+    """``self.x = get_recorder()`` -> "get_recorder" (type filled from
+    the factory's return annotation in the link phase)."""
+    if isinstance(value, ast.BoolOp):
+        for v in value.values:
+            got = _factory_call_name(v)
+            if got:
+                return got
+        return None
+    if isinstance(value, ast.Call) and isinstance(value.func, ast.Name):
+        name = value.func.id
+        if name and not name[0].isupper():
+            return name
+    return None
+
+
+class _Analyzer:
+    """One pass over one file tree, building the FileInfo model."""
+
+    def __init__(self, path: str, source: str, registry: LockRegistry):
+        self.reg = registry
+        self.fi = FileInfo(path, source, ast.parse(source))
+
+    # -- lock-expression resolution ------------------------------------------
+    def _resolve_lock(
+        self, expr: ast.expr, cls: Optional[ClassInfo], depth: int = 0
+    ) -> Optional[str]:
+        if depth > 4:
+            return None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and cls is not None
+        ):
+            attr = expr.attr
+            alias = cls.cond_aliases.get(attr)
+            if alias is not None:
+                fake = ast.Attribute(
+                    value=ast.Name(id="self", ctx=ast.Load()),
+                    attr=alias, ctx=ast.Load(),
+                )
+                return self._resolve_lock(fake, cls, depth + 1)
+            site = f"{self.fi.path}::{cls.name}.{attr}"
+            if site in self.reg.sites:
+                return self.reg.sites[site]
+            return cls.attr_locks.get(attr)
+        if isinstance(expr, ast.Name):
+            site = f"{self.fi.path}::GLOBAL.{expr.id}"
+            if site in self.reg.sites:
+                return self.reg.sites[site]
+            return self.fi.global_locks.get(expr.id)
+        return None
+
+    # -- construction inventory (SL1301 / SL1306 / aliases / types) ----------
+    def collect(self) -> FileInfo:
+        for node in self.fi.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._register_ctor(
+                            node.value, f"GLOBAL.{tgt.id}", None, tgt.id,
+                            node.lineno,
+                        )
+            elif isinstance(node, ast.ClassDef):
+                self._collect_class(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.fi.functions[node.name] = FuncInfo(
+                    self.fi.path, None, node.name, node,
+                    list(node.decorator_list),
+                )
+        # anonymous / nested lock constructions + joins + spawns + yields
+        self._sweep_calls()
+        # behavioral scan (needs aliases/locks from above)
+        for func in self.fi.functions.values():
+            self._scan_func(func, None)
+        for cls in self.fi.classes.values():
+            for meth in cls.methods.values():
+                self._scan_func(meth, cls)
+        return self.fi
+
+    def _collect_class(self, node: ast.ClassDef) -> None:
+        cls = ClassInfo(self.fi.path, node.name, node)
+        self.fi.classes[node.name] = cls
+        for item in node.body:
+            if isinstance(item, ast.Assign):
+                for tgt in item.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == "UNGUARDED_OK":
+                        vals = (
+                            item.value.elts
+                            if isinstance(item.value, (ast.Tuple, ast.List))
+                            else []
+                        )
+                        cls.unguarded_ok = tuple(
+                            v for v in (_str_const(e) for e in vals) if v
+                        )
+            elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls.methods[item.name] = FuncInfo(
+                    self.fi.path, node.name, item.name, item,
+                    list(item.decorator_list),
+                )
+        # attribute inventory from every method (locks usually live in
+        # __init__, but lazily-created ones count too)
+        for meth in cls.methods.values():
+            for sub in ast.walk(meth.node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for tgt in sub.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        self._note_self_assign(cls, tgt.attr, sub)
+
+    def _note_self_assign(
+        self, cls: ClassInfo, attr: str, assign: ast.Assign
+    ) -> None:
+        value = assign.value
+        if isinstance(value, ast.Call):
+            kind = _is_threading_ctor(value, ("Condition",))
+            if kind:
+                arg = value.args[0] if value.args else None
+                if (
+                    isinstance(arg, ast.Attribute)
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id == "self"
+                ):
+                    cls.cond_aliases[attr] = arg.attr
+                    return
+                # a bare Condition owns a fresh lock: registry rules apply
+                self._register_ctor(
+                    value, f"{cls.name}.{attr}", cls, None, assign.lineno
+                )
+                return
+            if _is_threading_ctor(value, _LOCK_CTORS) or _is_traced_ctor(
+                value
+            ):
+                self._register_ctor(
+                    value, f"{cls.name}.{attr}", cls, None, assign.lineno
+                )
+                return
+        ctor = _ctor_class_name(value)
+        if ctor:
+            cls.attr_types.setdefault(attr, ctor)
+        else:
+            factory = _factory_call_name(value)
+            if factory:
+                # resolved to a class via return annotation in link phase
+                cls.attr_types.setdefault(attr, f"()->{factory}")
+
+    def _register_ctor(
+        self,
+        call: ast.Call,
+        local_site: str,
+        cls: Optional[ClassInfo],
+        global_name: Optional[str],
+        line: int,
+    ) -> None:
+        """One lock construction: match it to the registry (SL1301) and
+        record the site as live (SL1306)."""
+        site = f"{self.fi.path}::{local_site}"
+        if _is_traced_ctor(call):
+            name = _str_const(call.args[0]) if call.args else None
+            if name is None:
+                self.fi.sl1301.append(
+                    (line, "traced-lock name must be a string literal")
+                )
+                return
+            if name not in self.reg.ranks:
+                self.fi.sl1301.append(
+                    (line, f"lock name {name!r} is not in LOCK_HIERARCHY")
+                )
+                return
+            self.fi.constructed_sites.append(site)
+            declared = self.reg.sites.get(site)
+            if declared is not None and declared != name:
+                self.fi.sl1301.append(
+                    (
+                        line,
+                        f"site {site} constructs {name!r} but the registry "
+                        f"declares it as {declared!r}",
+                    )
+                )
+            self._bind(cls, global_name, local_site, name)
+            return
+        if _is_threading_ctor(call, _LOCK_CTORS + ("Condition",)):
+            self.fi.constructed_sites.append(site)
+            name = self.reg.sites.get(site)
+            if name is None:
+                self.fi.sl1301.append(
+                    (
+                        line,
+                        f"undeclared lock at {site}: add a LOCK_HIERARCHY "
+                        "row in runtime/locks.py (or migrate to make_lock)",
+                    )
+                )
+                return
+            self._bind(cls, global_name, local_site, name)
+
+    def _bind(
+        self,
+        cls: Optional[ClassInfo],
+        global_name: Optional[str],
+        local_site: str,
+        lock_name: str,
+    ) -> None:
+        if cls is not None:
+            cls.attr_locks[local_site.split(".", 1)[1]] = lock_name
+        elif global_name is not None:
+            self.fi.global_locks[global_name] = lock_name
+
+    def _sweep_calls(self) -> None:
+        """File-wide sweep with parent links: thread spawns (and their
+        assignment targets), join evidence, yield_point sites, and lock
+        constructions that never land in a trackable slot."""
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.fi.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        tracked: set = set()
+        for node in ast.walk(self.fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if _is_threading_ctor(node, ("Thread",)):
+                self.fi.spawns.append(self._spawn_record(node, parents))
+            elif isinstance(f, ast.Attribute) and f.attr == "join":
+                base = f.value
+                if isinstance(base, ast.Name):
+                    self.fi.join_targets.add(base.id)
+                elif isinstance(base, ast.Attribute):
+                    self.fi.join_targets.add(base.attr)
+            elif isinstance(f, ast.Name) and f.id == "yield_point" or (
+                isinstance(f, ast.Attribute) and f.attr == "yield_point"
+            ):
+                arg = _str_const(node.args[0]) if node.args else None
+                self.fi.yield_calls.append((arg, node.lineno))
+            elif (
+                _is_threading_ctor(node, _LOCK_CTORS) or _is_traced_ctor(node)
+            ):
+                parent = parents.get(node)
+                while isinstance(parent, ast.BoolOp):
+                    parent = parents.get(parent)
+                if isinstance(parent, ast.Assign):
+                    tgt = parent.targets[0] if parent.targets else None
+                    trackable = isinstance(tgt, ast.Name) or (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    )
+                    if trackable and node not in tracked:
+                        continue  # handled by collect()/_collect_class()
+                self.fi.sl1301.append(
+                    (
+                        node.lineno,
+                        "lock constructed outside a trackable slot "
+                        "(module global or self attribute) — the registry "
+                        "cannot anchor it",
+                    )
+                )
+
+    def _spawn_record(self, call: ast.Call, parents: dict) -> dict:
+        rec = {
+            "line": call.lineno,
+            "daemon": False,
+            "target": None,
+            "assigned": None,
+        }
+        for kw in call.keywords:
+            if kw.arg == "daemon":
+                rec["daemon"] = (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                )
+            elif kw.arg == "target":
+                rec["target"] = kw.value
+        parent = parents.get(call)
+        if isinstance(parent, ast.Assign) and parent.targets:
+            tgt = parent.targets[0]
+            if isinstance(tgt, ast.Name):
+                rec["assigned"] = tgt.id
+            elif isinstance(tgt, ast.Attribute):
+                rec["assigned"] = tgt.attr
+        # the enclosing class (for loop/shutdown resolution)
+        node = call
+        while node in parents:
+            node = parents[node]
+            if isinstance(node, ast.ClassDef):
+                rec["class"] = node.name
+                break
+        return rec
+
+    # -- behavioral scan (held-stack walk) -----------------------------------
+    def _scan_func(self, fi: FuncInfo, cls: Optional[ClassInfo]) -> None:
+        held: List[str] = []
+        body = getattr(fi.node, "body", [])
+        self._scan_body(body, held, fi, cls)
+
+    def _scan_body(self, stmts, held, fi, cls) -> None:
+        for st in stmts:
+            self._scan_stmt(st, held, fi, cls)
+
+    def _scan_stmt(self, st, held, fi, cls) -> None:
+        if isinstance(st, ast.With):
+            pushed = 0
+            for item in st.items:
+                self._scan_expr(item.context_expr, held, fi, cls)
+                name = self._resolve_lock(item.context_expr, cls)
+                if name is not None:
+                    fi.acquires.append((name, st.lineno, tuple(held)))
+                    held.append(name)
+                    pushed += 1
+            self._scan_body(st.body, held, fi, cls)
+            for _ in range(pushed):
+                held.pop()
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            pass  # closures run later, under whatever locks THEY see
+        elif isinstance(st, ast.ClassDef):
+            pass
+        elif isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                st.targets if isinstance(st, ast.Assign) else [st.target]
+            )
+            for tgt in targets:
+                self._note_write_target(tgt, st.lineno, held, fi)
+                self._scan_expr(tgt, held, fi, cls)
+            if st.value is not None:
+                self._scan_expr(st.value, held, fi, cls)
+        else:
+            for field_name, value in ast.iter_fields(st):
+                if isinstance(value, list):
+                    if value and isinstance(value[0], ast.stmt):
+                        self._scan_body(value, held, fi, cls)
+                    else:
+                        for v in value:
+                            if isinstance(v, ast.expr):
+                                self._scan_expr(v, held, fi, cls)
+                            elif isinstance(v, (ast.excepthandler,)):
+                                self._scan_body(v.body, held, fi, cls)
+                            elif isinstance(v, ast.withitem):
+                                self._scan_expr(
+                                    v.context_expr, held, fi, cls
+                                )
+                elif isinstance(value, ast.expr):
+                    self._scan_expr(value, held, fi, cls)
+                elif isinstance(value, ast.stmt):
+                    self._scan_stmt(value, held, fi, cls)
+
+    def _note_write_target(self, tgt, line, held, fi) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._note_write_target(el, line, held, fi)
+            return
+        if isinstance(tgt, ast.Subscript):
+            tgt = tgt.value
+        if isinstance(tgt, ast.Starred):
+            tgt = tgt.value
+        if (
+            isinstance(tgt, ast.Attribute)
+            and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self"
+        ):
+            fi.writes.append((tgt.attr, line, tuple(held)))
+
+    def _scan_expr(self, expr, held, fi, cls) -> None:
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Lambda,)):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            self._note_call(node, held, fi, cls)
+
+    def _note_call(self, call: ast.Call, held, fi: FuncInfo, cls) -> None:
+        f = call.func
+        snapshot = tuple(held)
+        # lock acquisition without `with`
+        if isinstance(f, ast.Attribute) and f.attr == "acquire":
+            name = self._resolve_lock(f.value, cls)
+            if name is not None:
+                fi.acquires.append((name, call.lineno, snapshot))
+                return
+        # blocking-op inventory
+        if isinstance(f, ast.Name) and f.id == "open":
+            fi.blocking.append(("open() file I/O", call.lineno, snapshot))
+        elif isinstance(f, ast.Attribute):
+            if f.attr in _BLOCKING_ATTRS and not (
+                f.attr == "compile"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "re"
+            ):
+                fi.blocking.append(
+                    (f".{f.attr}()", call.lineno, snapshot)
+                )
+            elif f.attr == "lower" and call.args:
+                fi.blocking.append(
+                    (".lower(...) [jit lowering]", call.lineno, snapshot)
+                )
+            elif f.attr == "sleep" and isinstance(f.value, ast.Name) and (
+                f.value.id == "time"
+            ):
+                fi.blocking.append(
+                    ("time.sleep()", call.lineno, snapshot)
+                )
+            elif (
+                f.attr in _TIMEOUTLESS_ATTRS
+                and not call.args
+                and not call.keywords
+            ):
+                fi.blocking.append(
+                    (f"timeout-less .{f.attr}()", call.lineno, snapshot)
+                )
+        # call-graph references
+        ref = self._call_ref(f)
+        if ref is not None:
+            fi.calls.append((ref, call.lineno, snapshot))
+
+    @staticmethod
+    def _call_ref(f: ast.expr) -> Optional[tuple]:
+        if isinstance(f, ast.Name):
+            return ("name", f.id)
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name):
+                if base.id == "self":
+                    return ("self", f.attr)
+                return None
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                return ("attr", base.attr, f.attr)
+            if isinstance(base, ast.Call) and isinstance(
+                base.func, ast.Name
+            ):
+                return ("callret", base.func.id, f.attr)
+        return None
+
+
+# -- cross-file linking -------------------------------------------------------
+class _Program:
+    def __init__(self, files: Dict[str, FileInfo], registry: LockRegistry):
+        self.files = files
+        self.reg = registry
+        self.class_index: Dict[str, List[ClassInfo]] = {}
+        self.func_index: Dict[str, List[FuncInfo]] = {}
+        for f in files.values():
+            for c in f.classes.values():
+                self.class_index.setdefault(c.name, []).append(c)
+            for fn in f.functions.values():
+                self.func_index.setdefault(fn.name, []).append(fn)
+        self._resolve_factory_types()
+        self._acq_memo: Dict[int, Dict[str, tuple]] = {}
+        self._blk_memo: Dict[int, Dict[str, tuple]] = {}
+
+    def _unique_class(self, name: str) -> Optional[ClassInfo]:
+        hits = self.class_index.get(name, [])
+        return hits[0] if len(hits) == 1 else None
+
+    def _annotated_return_class(self, fname: str) -> Optional[str]:
+        hits = self.func_index.get(fname, [])
+        if len(hits) != 1:
+            return None
+        returns = getattr(hits[0].node, "returns", None)
+        if isinstance(returns, ast.Name):
+            return returns.id
+        if isinstance(returns, ast.Constant) and isinstance(
+            returns.value, str
+        ):
+            return returns.value.split("[")[0].strip()
+        if isinstance(returns, ast.Subscript) and isinstance(
+            returns.value, ast.Name
+        ) and returns.value.id == "Optional":
+            inner = returns.slice
+            if isinstance(inner, ast.Name):
+                return inner.id
+        return None
+
+    def _resolve_factory_types(self) -> None:
+        for f in self.files.values():
+            for c in f.classes.values():
+                for attr, tname in list(c.attr_types.items()):
+                    if tname.startswith("()->"):
+                        got = self._annotated_return_class(tname[4:])
+                        if got:
+                            c.attr_types[attr] = got
+                        else:
+                            del c.attr_types[attr]
+
+    def resolve_call(
+        self, ref: tuple, caller: FuncInfo
+    ) -> Optional[FuncInfo]:
+        kind = ref[0]
+        if kind == "self" and caller.class_name:
+            cls = self.files[caller.path].classes.get(caller.class_name)
+            return cls.methods.get(ref[1]) if cls else None
+        if kind == "attr" and caller.class_name:
+            cls = self.files[caller.path].classes.get(caller.class_name)
+            if cls is None:
+                return None
+            tname = cls.attr_types.get(ref[1])
+            target_cls = self._unique_class(tname) if tname else None
+            return target_cls.methods.get(ref[2]) if target_cls else None
+        if kind == "name":
+            fname = ref[1]
+            same_file = self.files[caller.path].functions.get(fname)
+            if same_file is not None:
+                return same_file
+            hits = self.func_index.get(fname, [])
+            if len(hits) == 1:
+                return hits[0]
+            ctor_cls = self._unique_class(fname)
+            if ctor_cls is not None:
+                return ctor_cls.methods.get("__init__")
+            return None
+        if kind == "callret":
+            tname = self._annotated_return_class(ref[1])
+            target_cls = self._unique_class(tname) if tname else None
+            return target_cls.methods.get(ref[2]) if target_cls else None
+        return None
+
+    def _transitive(self, fi: FuncInfo, memo, direct, visiting=None) -> dict:
+        key = id(fi)
+        if key in memo:
+            return memo[key]
+        if visiting is None:
+            visiting = set()
+        if key in visiting:
+            return {}
+        visiting.add(key)
+        out: Dict[str, tuple] = {}
+        for item in direct(fi):
+            out.setdefault(item[0], (fi.qualname, item[1]))
+        for ref, line, _held in fi.calls:
+            target = self.resolve_call(ref, fi)
+            if target is None:
+                continue
+            for name, prov in self._transitive(
+                target, memo, direct, visiting
+            ).items():
+                out.setdefault(name, prov)
+        visiting.discard(key)
+        memo[key] = out
+        return out
+
+    def acquires_of(self, fi: FuncInfo) -> Dict[str, tuple]:
+        """lock name -> (qualname, line) of every lock fi may acquire,
+        transitively through resolvable calls."""
+        return self._transitive(
+            fi, self._acq_memo, lambda f: [(a[0], a[1]) for a in f.acquires]
+        )
+
+    def blocking_of(self, fi: FuncInfo) -> Dict[str, tuple]:
+        return self._transitive(
+            fi, self._blk_memo, lambda f: [(b[0], b[1]) for b in f.blocking]
+        )
+
+
+# -- rule evaluation ----------------------------------------------------------
+def _iter_funcs(files: Dict[str, FileInfo]):
+    for f in files.values():
+        for fn in f.functions.values():
+            yield f, None, fn
+        for c in f.classes.values():
+            for fn in c.methods.values():
+                yield f, c, fn
+
+
+def _check_orders(prog: _Program, out: List[Finding]) -> None:
+    ranks = prog.reg.ranks
+    for f, _cls, fn in _iter_funcs(prog.files):
+        for name, line, held in fn.acquires:
+            for h in held:
+                if name in ranks and h in ranks and ranks[name] <= ranks[h]:
+                    out.append(Finding(
+                        "SL1302", f.path, line,
+                        f"acquires {name!r} (rank {ranks[name]}) while "
+                        f"holding {h!r} (rank {ranks[h]}) — inverts "
+                        "LOCK_HIERARCHY",
+                    ))
+        seen = set()
+        for ref, line, held in fn.calls:
+            if not held:
+                continue
+            target = prog.resolve_call(ref, fn)
+            if target is None or target is fn:
+                continue
+            for name, (qual, at) in prog.acquires_of(target).items():
+                for h in held:
+                    if (
+                        name in ranks and h in ranks
+                        and ranks[name] <= ranks[h]
+                        and (line, h, name) not in seen
+                    ):
+                        seen.add((line, h, name))
+                        out.append(Finding(
+                            "SL1302", f.path, line,
+                            f"holding {h!r} (rank {ranks[h]}), this call "
+                            f"reaches {qual} which acquires {name!r} "
+                            f"(rank {ranks[name]}) at line {at} — "
+                            "inverts LOCK_HIERARCHY",
+                        ))
+
+
+def _check_blocking(prog: _Program, out: List[Finding]) -> None:
+    hot = prog.reg.no_blocking
+    if not hot:
+        return
+    for f, _cls, fn in _iter_funcs(prog.files):
+        for desc, line, held in fn.blocking:
+            locked = [h for h in held if h in hot]
+            if locked:
+                out.append(Finding(
+                    "SL1303", f.path, line,
+                    f"blocking op {desc} while holding dispatch-class "
+                    f"lock {locked[0]!r} — compiles/I/O must move outside "
+                    "(the PR-11 contract)",
+                ))
+        seen = set()
+        for ref, line, held in fn.calls:
+            locked = [h for h in held if h in hot]
+            if not locked:
+                continue
+            target = prog.resolve_call(ref, fn)
+            if target is None or target is fn:
+                continue
+            for desc, (qual, at) in prog.blocking_of(target).items():
+                if (line, desc) in seen:
+                    continue
+                seen.add((line, desc))
+                out.append(Finding(
+                    "SL1303", f.path, line,
+                    f"holding dispatch-class lock {locked[0]!r}, this "
+                    f"call reaches {qual} which does {desc} at line {at}",
+                ))
+
+
+def _loop_has_shutdown(
+    cls: Optional[ClassInfo], target_fn: FuncInfo
+) -> Optional[str]:
+    """None when the worker loop can exit; else a complaint."""
+    for node in ast.walk(target_fn.node):
+        if not isinstance(node, ast.While):
+            continue
+        if isinstance(node.test, ast.Constant) and node.test.value is True:
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Return, ast.Break)):
+                    break
+            else:
+                return (
+                    f"worker loop in {target_fn.qualname} is `while True` "
+                    "with no return/break — no shutdown path"
+                )
+        else:
+            # stop-event loops: some method must call .set() on the event
+            evt = None
+            for sub in ast.walk(node.test):
+                if (
+                    isinstance(sub, ast.Attribute)
+                    and sub.attr == "is_set"
+                    and isinstance(sub.value, ast.Attribute)
+                    and isinstance(sub.value.value, ast.Name)
+                    and sub.value.value.id == "self"
+                ):
+                    evt = sub.value.attr
+            if evt is not None and cls is not None:
+                for meth in cls.methods.values():
+                    for sub in ast.walk(meth.node):
+                        if (
+                            isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "set"
+                            and isinstance(sub.func.value, ast.Attribute)
+                            and isinstance(
+                                sub.func.value.value, ast.Name
+                            )
+                            and sub.func.value.value.id == "self"
+                            and sub.func.value.attr == evt
+                        ):
+                            return None
+                return (
+                    f"worker loop in {target_fn.qualname} waits on "
+                    f"self.{evt} but no method ever calls "
+                    f"self.{evt}.set() — stop() cannot reach it"
+                )
+    return None
+
+
+def _check_threads(prog: _Program, out: List[Finding]) -> None:
+    for f in prog.files.values():
+        for spawn in f.spawns:
+            joined = (
+                spawn["assigned"] is not None
+                and spawn["assigned"] in f.join_targets
+            )
+            if not spawn["daemon"] and not joined:
+                out.append(Finding(
+                    "SL1304", f.path, spawn["line"],
+                    "spawned Thread is neither daemon=True nor joined "
+                    "anywhere in this file — it outlives shutdown "
+                    "(the PR-12 leak class)",
+                ))
+            target = spawn.get("target")
+            cls = f.classes.get(spawn.get("class", ""))
+            target_fn = None
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+                and cls is not None
+            ):
+                target_fn = cls.methods.get(target.attr)
+            elif isinstance(target, ast.Name):
+                target_fn = f.functions.get(target.id)
+            if target_fn is not None:
+                complaint = _loop_has_shutdown(cls, target_fn)
+                if complaint:
+                    out.append(Finding(
+                        "SL1304", f.path, spawn["line"], complaint
+                    ))
+
+
+def _route_locked(fn: FuncInfo) -> bool:
+    """True for methods behind a locked-dispatch decorator (``@route``
+    without ``locked=False``): the dispatcher holds the class's ``lock``
+    around the call, a real-but-non-lexical guard."""
+    for deco in fn.decorators:
+        if not isinstance(deco, ast.Call):
+            continue
+        name = (
+            deco.func.id if isinstance(deco.func, ast.Name)
+            else deco.func.attr if isinstance(deco.func, ast.Attribute)
+            else None
+        )
+        if name != "route":
+            continue
+        for kw in deco.keywords:
+            if kw.arg == "locked" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return True
+    return False
+
+
+def _check_shared_writes(prog: _Program, out: List[Finding]) -> None:
+    for f in prog.files.values():
+        spawning = {s.get("class") for s in f.spawns if s.get("class")}
+        for cls in f.classes.values():
+            in_scope = cls.name in spawning or bool(cls.attr_locks) or any(
+                f"{f.path}::{cls.name}.{attr}" in prog.reg.sites
+                for meth in cls.methods.values()
+                for attr in [None]  # placeholder; sites checked below
+            )
+            declared_attrs = {
+                site.split("::", 1)[1].split(".", 1)[1]: name
+                for site, name in prog.reg.sites.items()
+                if site.startswith(f"{f.path}::{cls.name}.")
+            }
+            in_scope = cls.name in spawning or bool(cls.attr_locks) or bool(
+                declared_attrs
+            )
+            if not in_scope:
+                continue
+            own_locks = dict(cls.attr_locks)
+            own_locks.update(declared_attrs)
+            # guard evidence per attribute: lock name or None per write
+            per_attr: Dict[str, List[Tuple[Optional[str], int]]] = {}
+            for meth in cls.methods.values():
+                if meth.name in ("__init__", "__post_init__", "__new__"):
+                    continue
+                contract = None
+                if _route_locked(meth) and "lock" in own_locks:
+                    contract = own_locks["lock"]
+                if contract is None:
+                    contract = _caller_held_guard(prog, f, cls, meth)
+                for attr, line, held in meth.writes:
+                    if attr in own_locks or attr in cls.cond_aliases:
+                        continue  # the locks themselves
+                    guard = next(
+                        (h for h in held if h in prog.reg.ranks), None
+                    )
+                    if guard is None:
+                        guard = contract
+                    per_attr.setdefault(attr, []).append((guard, line))
+            for attr, sites in sorted(per_attr.items()):
+                if attr in cls.unguarded_ok:
+                    continue
+                unguarded = [line for g, line in sites if g is None]
+                names = {g for g, _line in sites if g is not None}
+                if unguarded:
+                    out.append(Finding(
+                        "SL1305", f.path, unguarded[0],
+                        f"{cls.name}.{attr} is written without holding a "
+                        "registered lock (class "
+                        + ("spawns threads" if cls.name in spawning
+                           else "owns registered locks")
+                        + ") — guard it, or declare it in UNGUARDED_OK "
+                        "with the single-writer argument",
+                    ))
+                elif len(names) > 1:
+                    out.append(Finding(
+                        "SL1305", f.path, sites[0][1],
+                        f"{cls.name}.{attr} is guarded by different locks "
+                        f"at different sites ({sorted(names)}) — mutual "
+                        "exclusion does not compose across locks",
+                    ))
+
+
+def _caller_held_guard(
+    prog: _Program, f: FileInfo, cls: ClassInfo, meth: FuncInfo
+) -> Optional[str]:
+    """'Caller holds the lock' contract: if EVERY same-class call site
+    of this method runs under one common registered lock, that lock
+    guards the method's writes."""
+    common: Optional[set] = None
+    for other in cls.methods.values():
+        if other is meth:
+            continue
+        for ref, _line, held in other.calls:
+            if ref[0] == "self" and ref[1] == meth.name:
+                locks = {h for h in held if h in prog.reg.ranks}
+                common = locks if common is None else (common & locks)
+    if common:
+        return sorted(common)[0]
+    return None
+
+
+def _check_registry_liveness(
+    prog: _Program, files: Dict[str, FileInfo], out: List[Finding]
+) -> None:
+    constructed = set()
+    for f in files.values():
+        constructed.update(f.constructed_sites)
+    scanned_paths = set(files)
+    for site, name in sorted(prog.reg.sites.items()):
+        path = site.split("::", 1)[0]
+        if path not in scanned_paths:
+            continue  # file outside this (possibly synthetic) tree
+        if site not in constructed:
+            out.append(Finding(
+                "SL1306", path, 1,
+                f"registry row {name!r} declares site {site} but no lock "
+                "is constructed there — stale declaration",
+            ))
+
+
+def _check_yield_points(
+    prog: _Program, files: Dict[str, FileInfo], out: List[Finding]
+) -> None:
+    catalog = set(prog.reg.yield_points)
+    seen = set()
+    for f in files.values():
+        for name, line in f.yield_calls:
+            if name is None:
+                out.append(Finding(
+                    "SL1307", f.path, line,
+                    "yield_point() name must be a string literal",
+                ))
+            elif name not in catalog:
+                out.append(Finding(
+                    "SL1307", f.path, line,
+                    f"yield point {name!r} is not in the YIELD_POINTS "
+                    "catalog (runtime/locks.py)",
+                ))
+            else:
+                seen.add(name)
+    if any(f.yield_calls for f in files.values()):
+        for name in sorted(catalog - seen):
+            out.append(Finding(
+                "SL1307", "runtime/locks.py", 1,
+                f"YIELD_POINTS entry {name!r} has no yield_point() call "
+                "site in the tree — stale catalog row",
+            ))
+
+
+# -- entry points -------------------------------------------------------------
+def check_files(
+    files: Dict[str, str], registry: LockRegistry
+) -> List[Finding]:
+    """Audit a ``{relpath: source}`` tree (paths package-relative, e.g.
+    ``serve/scheduler.py``) against an explicit registry.  The fixture
+    entry point; ``check_concurrency`` wraps it for a real tree."""
+    infos: Dict[str, FileInfo] = {}
+    findings: List[Finding] = []
+    for path, source in sorted(files.items()):
+        try:
+            infos[path] = _Analyzer(path, source, registry).collect()
+        except SyntaxError as e:
+            findings.append(Finding(
+                "SL1301", path, e.lineno or 1,
+                f"unparseable file: {e.msg}",
+            ))
+    prog = _Program(infos, registry)
+    for f in infos.values():
+        for line, msg in f.sl1301:
+            findings.append(Finding("SL1301", f.path, line, msg))
+    _check_orders(prog, findings)
+    _check_blocking(prog, findings)
+    _check_threads(prog, findings)
+    _check_shared_writes(prog, findings)
+    _check_registry_liveness(prog, infos, findings)
+    _check_yield_points(prog, infos, findings)
+    kept: List[Finding] = []
+    for path, group in _group_by_path(findings).items():
+        src = files.get(path)
+        if src is None:
+            kept.extend(group)
+        else:
+            kept.extend(apply_suppressions(group, src))
+    kept.sort(key=lambda x: (x.path, x.line, x.rule))
+    return kept
+
+
+def _group_by_path(findings: List[Finding]) -> Dict[str, List[Finding]]:
+    groups: Dict[str, List[Finding]] = {}
+    for f in findings:
+        groups.setdefault(f.path, []).append(f)
+    return groups
+
+
+def check_concurrency(root: str) -> List[Finding]:
+    """Pass-10 entry for a real tree rooted at ``root`` (the repo
+    checkout).  Findings come back with paths relative to ``root`` so
+    the CLI's remapping applies uniformly."""
+    pkg = os.path.join(root, "wittgenstein_tpu")
+    files: Dict[str, str] = {}
+    for sub in HOST_DIRS:
+        base = os.path.join(pkg, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, _dirs, names in os.walk(base):
+            for name in sorted(names):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, pkg).replace(os.sep, "/")
+                if rel in EXEMPT:
+                    continue
+                with open(full, "r", encoding="utf-8") as fh:
+                    files[rel] = fh.read()
+    registry = load_registry(os.path.join(pkg, "runtime", "locks.py"))
+    findings = check_files(files, registry)
+    return [
+        dataclasses.replace(
+            f,
+            path=os.path.join(root, "wittgenstein_tpu", f.path)
+            if not os.path.isabs(f.path)
+            else f.path,
+        )
+        for f in findings
+    ]

@@ -110,6 +110,31 @@ RULES: Dict[str, str] = {
     "whose tick_beat jaxpr is not a no-op (or that also declares "
     "BEAT_PERIOD) — the next-arrival jump paths skip empty-occupancy "
     "ticks wholesale, so per-tick beat work would silently vanish",
+    # -- concurrency contract checker (pass 10) ---------------------------------
+    "SL1301": "undeclared lock: a threading.Lock/RLock/Condition "
+    "construction site missing from the runtime/locks.py registry, or a "
+    "make_lock/TracedLock name absent from LOCK_HIERARCHY",
+    "SL1302": "lock-order inversion: an acquisition chain — direct or "
+    "across function boundaries via call-graph inference — takes a lock "
+    "at or below the rank of one already held, inverting the declared "
+    "LOCK_HIERARCHY total order (the deadlock-order audit)",
+    "SL1303": "blocking work under a dispatch-class lock: compile/lower/"
+    "block_until_ready, file I/O, HTTP, time.sleep, or a timeout-less "
+    "get()/wait()/join() reachable while a no_blocking lock is held "
+    "(the PR-11 compile-race dual: compiles stay OUTSIDE _dispatch_lock)",
+    "SL1304": "thread lifecycle: a spawned threading.Thread is neither "
+    "daemonized nor joined, or its worker loop has no shutdown path "
+    "reachable from stop()/drain (the PR-12 watchdog-leak class)",
+    "SL1305": "unguarded shared write: a mutable attribute of a "
+    "thread-spawning or lock-owning class is written without holding its "
+    "class's named lock at every site, or guarded by different locks at "
+    "different sites (UNGUARDED_OK declares documented single-writer "
+    "fields)",
+    "SL1306": "stale lock registry: a runtime/locks.py site declaration "
+    "matches no live lock construction in the tree",
+    "SL1307": "yield-point catalog drift: a yield_point() call site names "
+    "a point missing from YIELD_POINTS, or a catalog entry has no call "
+    "site left in the tree",
 }
 
 

@@ -106,6 +106,7 @@ KNOWN_KINDS = (
     "slo-alert",
     "slo-resolved",
     "invariant-violation",
+    "lock-order-violation",
 )
 
 
@@ -119,7 +120,12 @@ class FlightRecorder:
         self.path = path
         self._ring: collections.deque = collections.deque(maxlen=self.capacity)
         self._seq = itertools.count()
-        self._lock = threading.Lock()
+        # deferred import: runtime/__init__ -> supervisor -> obs ->
+        # recorder would cycle if this sat at module level; recorders
+        # are only ever constructed after imports settle
+        from ..runtime.locks import make_lock
+
+        self._lock = make_lock("obs.recorder")
         if path:
             parent = os.path.dirname(path)
             if parent:

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..profiling.xla_cost import compiled_cost_summary
+from ..runtime.locks import make_lock, yield_point
 
 
 def shard_replicas(states, mesh: Mesh, axis: str = "replicas"):
@@ -60,7 +61,12 @@ _RUN_CACHE_MAX = 64
 # entry creation is check-then-act; concurrent callers (serve batch
 # workers, sweep threads) must not each install their own _CachedRun
 # for one key — that duplicates the compile despite the per-entry lock
-_CACHE_LOCK = threading.Lock()
+_CACHE_LOCK = make_lock("runcache.entry")
+
+# the PR-11 guard: recheck the program table AFTER taking the compile
+# lock.  Module-level so the regression test can deliberately revert it
+# and prove the interleaving harness reproduces the duplicate compile
+_RECHECK_UNDER_LOCK = True
 
 # monotonic across clear_run_cache() — Prometheus counters must never
 # step backwards just because a campaign flushed the program cache
@@ -139,7 +145,7 @@ class _CachedRun:
         # duplicate a multi-second compile (observed from concurrent
         # serve batches).  Double-checked locking keeps the per-geometry
         # compile a true singleton.
-        self._compile_lock = threading.Lock()
+        self._compile_lock = make_lock("runcache.compile")
 
     @staticmethod
     def _signature(states) -> tuple:
@@ -175,9 +181,16 @@ class _CachedRun:
         sig = self._signature(states)
         compiled = self._programs.get(sig)
         if compiled is None:
+            # the PR-11 race window: between this unlocked miss and the
+            # locked recheck another thread can finish the same compile.
+            # The interleaving harness parks threads here to force that
+            # schedule deterministically (tests/interleave.py)
+            yield_point("runcache.lookup-miss")
             with self._compile_lock:
-                compiled = self._programs.get(sig)
+                if _RECHECK_UNDER_LOCK:
+                    compiled = self._programs.get(sig)
                 if compiled is None:
+                    yield_point("runcache.compile")
                     from ..runtime.compile_store import (
                         get_compile_store,
                         mesh_geometry_signature,

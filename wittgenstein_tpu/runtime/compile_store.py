@@ -45,6 +45,8 @@ import pickle
 import threading
 from typing import Any, Callable, Dict, Optional
 
+from .locks import make_lock, yield_point
+
 STORE_FORMAT = "witt-compile-store/v1"
 
 #: monotonic per-process counters (Prometheus discipline: survive
@@ -98,7 +100,7 @@ class CompileStore:
     def __init__(self, directory: str):
         self.directory = str(directory)
         os.makedirs(self.directory, exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = make_lock("store.entry")
 
     # -- keying ---------------------------------------------------------
 
@@ -125,6 +127,7 @@ class CompileStore:
         diagnosable, not just a miss.  Returns False (counted as an
         error) when the executable refuses to serialize or the
         filesystem refuses the write."""
+        yield_point("store.put")
         from jax.experimental import serialize_executable
 
         try:
@@ -177,6 +180,7 @@ class CompileStore:
         given, an entry recorded under a different mesh shape — same
         device COUNT, different (axis, size) factorization, e.g. (2,4)
         vs (4,2) of 8 devices — is stale, never served."""
+        yield_point("store.get")
         man_path, bin_path = self._paths(stable_key)
         try:
             with open(man_path, "rb") as f:

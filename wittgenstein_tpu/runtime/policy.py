@@ -85,6 +85,11 @@ class WatchdogWorker:
     process-level supervisor's job (scripts/tpu_campaign.py).
     """
 
+    # single-writer by construction: only the owning caller thread ever
+    # touches the worker handle or the hung latch (the worker thread
+    # itself writes neither), so neither needs a lock (SL1305)
+    UNGUARDED_OK = ("_thread", "hung")
+
     def __init__(self, name: str = "witt-watchdog"):
         self._name = name
         self._requests: "queue.Queue" = queue.Queue()

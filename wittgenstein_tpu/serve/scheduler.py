@@ -99,6 +99,7 @@ from ..runtime.errors import (
     PoisonRowError,
     classify,
 )
+from ..runtime.locks import make_lock, yield_point
 from ..runtime.policy import SalvagePolicy
 from .jobs import (
     DrainingError,
@@ -302,7 +303,7 @@ class BatchScheduler:
         # process by default, see obs.get_recorder)
         self.recorder = recorder if recorder is not None else get_recorder()
         self._families: Dict[str, ScenarioFamily] = {}
-        self._fam_lock = threading.Lock()
+        self._fam_lock = make_lock("serve.family")
         self._parked: List[_ParkedBatch] = []
         self._batch_seq = 0
         # Retry-After pacing: per-family EMA of batch wall time (a slow
@@ -336,10 +337,10 @@ class BatchScheduler:
             ]
         self.device_groups = len(self._lanes)
         self.node_parallel = node_parallel
-        self._dispatch_lock = threading.Lock()
+        self._dispatch_lock = make_lock("serve.dispatch")
         self._family_lane: Dict[str, int] = {}
         self._active_dispatches = 0
-        self._worker_lock = threading.Lock()
+        self._worker_lock = make_lock("serve.worker")
         self._stop = threading.Event()
         # -- fleet resilience ------------------------------------------
         # sticky bindings expire once a family has had no queued work
@@ -460,12 +461,15 @@ class BatchScheduler:
         return max(1, int(batches_ahead * ema / lanes + 0.5))
 
     def _note_batch_time(self, compat: Optional[str], dt: float) -> None:
-        self._ema_batch_s = 0.5 * self._ema_batch_s + 0.5 * dt
-        if compat:
-            prev = self._ema_family.get(compat)
-            self._ema_family[compat] = (
-                dt if prev is None else 0.5 * prev + 0.5 * dt
-            )
+        # lanes finish batches concurrently: the EMA read-modify-write
+        # must not interleave (SL1305)
+        with self._dispatch_lock:
+            self._ema_batch_s = 0.5 * self._ema_batch_s + 0.5 * dt
+            if compat:
+                prev = self._ema_family.get(compat)
+                self._ema_family[compat] = (
+                    dt if prev is None else 0.5 * prev + 0.5 * dt
+                )
 
     def submit(self, spec_dict: dict) -> Job:
         """Parse, validate, and enqueue one job (raises ValueError /
@@ -717,6 +721,7 @@ class BatchScheduler:
         queued and parked batches stay checkpoint-parked."""
         if self._draining.is_set():
             return None
+        yield_point("serve.claim")
         with self._dispatch_lock:
             parked = max(
                 (
@@ -817,6 +822,7 @@ class BatchScheduler:
         self._finish_job(job, JobState.DONE, result=result)
 
     def _dispatch(self, jobs: List[Job], lane: _Lane) -> None:
+        yield_point("serve.dispatch")
         live = []
         for j in jobs:
             if j.cancel_requested:
@@ -1149,6 +1155,7 @@ class BatchScheduler:
         ONE new input geometry inside the family's existing run-cache
         entry, compiled once ever and published to the compile store —
         the mixed-workload compile pin holds."""
+        yield_point("serve.harvest")
         import jax
         import numpy as np
 
@@ -1603,6 +1610,7 @@ class BatchScheduler:
         healthy lanes — or drop the bindings entirely in a single-lane
         fleet so the replacement worker re-binds on its next claim —
         then spawn the replacement with a crash-loop backoff."""
+        yield_point("serve.lane-failure")
         kind = classify(exc)
         lane.fail_streak += 1
         victim = lane.last_ctx
@@ -1799,6 +1807,7 @@ class BatchScheduler:
             get_compile_store,
         )
         from ..runtime.errors import taxonomy_counters
+        from ..runtime.locks import lock_trace_status
 
         with self._dispatch_lock:
             lanes = [lane.describe() for lane in self._lanes]
@@ -1811,6 +1820,7 @@ class BatchScheduler:
         # pull-driven SLO evaluation: every health poll refreshes the
         # burn-rate state (edge-triggered alerts fire here)
         self.slo.evaluate()
+        lt = lock_trace_status()
         return {
             "queueDepth": self.queue.depth(),
             "queueCapacity": self.queue.max_depth,
@@ -1833,6 +1843,10 @@ class BatchScheduler:
             "runCache": run_cache_info(),
             "errorKinds": taxonomy_counters(),
             "alerts": self.slo.alert_counts(),
+            "lockTrace": {
+                k: lt[k]
+                for k in ("armed", "maxWaitS", "waitP99S", "violationCount")
+            },
         }
 
     def slo_status(self) -> dict:
@@ -1862,9 +1876,25 @@ class BatchScheduler:
 
     def add_prometheus(self, p) -> None:
         from ..runtime.errors import taxonomy_counters
+        from ..runtime.locks import lock_trace_status
 
         self.metrics.add_prometheus(p, self.queue)
         self.slo.add_prometheus(p)
+        lt = lock_trace_status()
+        if lt["armed"]:
+            for name, row in sorted(lt["perLock"].items()):
+                p.add(
+                    "runtime_lock_wait_seconds", row["waitSecondsTotal"],
+                    "cumulative seconds spent waiting to acquire each "
+                    "registered lock (WITT_LOCK_TRACE only)",
+                    "counter", {"lock": name},
+                )
+            p.add(
+                "runtime_lock_order_violations_total",
+                lt["violationCount"],
+                "distinct lock-order violations observed by TracedLock",
+                "counter",
+            )
         p.add(
             "serve_draining",
             1 if self._draining.is_set() else 0,

@@ -20,7 +20,7 @@ framework is available in the image, and none is needed:
 
 from .external import ExternalMockImplementation, ExternalRest
 from .server import Server
-from .ws import WServer, serve
+from .ws import WServer, serve, shutdown_server
 
 __all__ = [
     "ExternalMockImplementation",
@@ -28,4 +28,5 @@ __all__ = [
     "Server",
     "WServer",
     "serve",
+    "shutdown_server",
 ]

@@ -127,6 +127,11 @@ class WServer:
     #: sim-ms advanced per lock hold; interrupt/busy checks run between
     RUN_SLICE_MS = 50
 
+    # single-writer by the run_lock latch: only the one in-flight runMs
+    # (serialized by run_lock) writes the progress/EMA fields; readers
+    # (_retry_after_s) tolerate a stale float (SL1305)
+    UNGUARDED_OK = ("_run_started", "_run_ms_total", "_run_rate_s_per_ms")
+
     def __init__(self, scheduler: Optional[BatchScheduler] = None):
         self.server = Server()
         # multi-tenant job path (serve/): construction is light — the
@@ -197,9 +202,12 @@ class WServer:
             except Exception as e:
                 # a slice blew up mid-run: latch degraded so clients get
                 # an honest 503 (with the reason) until the operator
-                # re-inits, instead of racing a broken sim
-                self.degraded = True
-                self.degraded_reason = f"{type(e).__name__}: {e}"
+                # re-inits, instead of racing a broken sim.  The latch
+                # is written under the shared lock like every other
+                # writer (init holds it via the route dispatcher)
+                with self.lock:
+                    self.degraded = True
+                    self.degraded_reason = f"{type(e).__name__}: {e}"
                 raise
             dt = time.monotonic() - t0
             if done:
@@ -705,13 +713,28 @@ class _Handler(BaseHTTPRequestHandler):
 
 def serve(port: int = 0, ws: Optional[WServer] = None) -> ThreadingHTTPServer:
     """Start the HTTP server on `port` (0 = ephemeral); returns the server
-    (serve_forever runs on a daemon thread; .shutdown() to stop)."""
+    (serve_forever runs on a daemon thread; shutdown_server() — or
+    .shutdown() — to stop)."""
     ws = ws or WServer()
     handler = type("BoundHandler", (_Handler,), {"ws": ws})
     httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
+    # keep the handle so shutdown can JOIN the accept loop instead of
+    # abandoning a daemon thread mid-accept (simlint SL1304 discipline)
+    httpd._witt_serve_thread = t
     return httpd
+
+
+def shutdown_server(httpd: ThreadingHTTPServer, timeout_s: float = 10.0) -> None:
+    """Stop serve_forever AND join its thread — the orderly dual of
+    serve().  A plain .shutdown() leaves the daemon thread to die with
+    the process; joining makes teardown deterministic for tests and
+    smoke scripts."""
+    httpd.shutdown()
+    t = getattr(httpd, "_witt_serve_thread", None)
+    if t is not None:
+        t.join(timeout=timeout_s)
 
 
 if __name__ == "__main__":
@@ -723,4 +746,4 @@ if __name__ == "__main__":
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
-        httpd.shutdown()
+        shutdown_server(httpd)

@@ -64,8 +64,8 @@ class SpanTracer:
         subsequent span/instant, and emitted once as a metadata event
         so the ids survive even in a span-free trace."""
         ids = dict(ctx.ids()) if hasattr(ctx, "ids") else dict(ctx)
-        self._ctx_ids = ids
         with self._lock:
+            self._ctx_ids = ids
             self.events.append(
                 {
                     "ph": "M",
