@@ -115,6 +115,20 @@ def _load_mesh(root: str):
         return None
 
 
+def _load_search(root: str):
+    """The adversary-search benchmark record (BENCH_SEARCH.json,
+    witt-bench-search/v1, written by scripts/adversary_smoke.py):
+    evals/sec through the cached sweep path, generation count, the
+    champion-objective trajectory, and its own documented evals/sec
+    floor + note (the accepted-regression channel, like
+    BENCH_FLOOR.json).  Optional — absent until the smoke has run."""
+    try:
+        with open(os.path.join(root, "BENCH_SEARCH.json")) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 def _round_row(path: str, budget) -> dict:
     with open(path) as f:
         doc = json.load(f)
@@ -223,6 +237,7 @@ def build_trend(root: str = ROOT) -> dict:
         "budget": _load_budget(root),
         "serve": _load_serve(root),
         "mesh": _load_mesh(root),
+        "search": _load_search(root),
     }
     return trend
 
@@ -353,6 +368,42 @@ def check(trend: dict) -> list:
                 "BENCH_MESH.json records a failed 2D-mesh ladder"
                 + (f" — rungs {', '.join(bad)}" if bad else " (no rungs)")
             )
+    # adversary-search throughput (ISSUE 20): the committed record
+    # carries its own evals/sec floor + note (same documentation
+    # discipline as BENCH_FLOOR.json) — an evals/sec below it is an
+    # UNDOCUMENTED search-throughput regression; a champion trajectory
+    # that ever decreases means the strict-improvement champion update
+    # broke (it is best-so-far by construction)
+    search = trend.get("search")
+    if search is not None:
+        if search.get("schema") != "witt-bench-search/v1":
+            problems.append(
+                f"BENCH_SEARCH.json has unknown schema "
+                f"{search.get('schema')!r} (expected witt-bench-search/v1)"
+            )
+        else:
+            if not search.get("ok", False):
+                problems.append(
+                    "BENCH_SEARCH.json records a failed adversary smoke: "
+                    + "; ".join(search.get("failures", ["unknown"]))[:300]
+                )
+            eps = search.get("evals_per_sec")
+            eps_floor = search.get("evals_per_sec_floor")
+            if eps is not None and eps_floor is not None and eps < eps_floor:
+                problems.append(
+                    f"BENCH_SEARCH.json evals/sec {eps} is below its "
+                    f"documented floor {eps_floor} — an UNDOCUMENTED "
+                    "search-throughput regression.  Either fix the perf "
+                    "or re-record the floor with a note explaining the "
+                    "accepted level."
+                )
+            traj = search.get("champion_trajectory") or []
+            if any(b < a for a, b in zip(traj, traj[1:])):
+                problems.append(
+                    "BENCH_SEARCH.json champion_trajectory decreases "
+                    f"({traj}) — the best-so-far champion update is "
+                    "broken"
+                )
     return problems
 
 

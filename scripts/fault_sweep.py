@@ -1,18 +1,30 @@
 """Fault sweep: heterogeneous fault plans across replicas, one compile.
 
-Builds a toy P2PFlood simulation and runs FIVE fault scenarios — a
-fault-free control, a 20% crash at t=200ms, a two-way partition window,
-probabilistic message drop, and latency inflation — as replica rows of
-ONE `run_ms_batched` invocation (the schedules are FaultState data, not
-traced branches, so the whole sweep is a single jit).  Emits an
-availability-vs-latency report plus a JSONL run record, and FAILS
-LOUDLY if the sweep misbehaves: the control row must be bit-identical
-to a fault-free singleton run (fault-off neutrality at full scale), the
-crash row must lose availability, and the drop/inflation counters must
-show their lanes fired.  CI runs this as the tier-1 fault step and
-uploads the output directory as a build artifact.
+Default mode builds a toy P2PFlood simulation and runs FIVE fault
+scenarios — a fault-free control, a 20% crash at t=200ms, a two-way
+partition window, probabilistic message drop, and latency inflation —
+as replica rows of ONE `run_ms_batched` invocation (the schedules are
+FaultState data, not traced branches, so the whole sweep is a single
+jit).  Emits an availability-vs-latency report plus a JSONL run record,
+and FAILS LOUDLY if the sweep misbehaves: the control row must be
+bit-identical to a fault-free singleton run (fault-off neutrality at
+full scale), the crash row must lose availability, and the drop/
+inflation counters must show their lanes fired.  CI runs this as the
+tier-1 fault step and uploads the output directory as a build artifact.
 
-Usage: python scripts/fault_sweep.py [out_dir]   (default ./fault_sweep)
+--search mode turns the same machinery into a RESUMABLE adversary
+search (wittgenstein_tpu.search): an optimizer population lowers to
+heterogeneous FaultPlans, each generation is one cached batched sweep,
+generation state checkpoints under <out_dir>/checkpoints, and the run
+emits a frontier report (report.json) — interrupt it and re-invoke with
+the same arguments to resume.  --pin writes the champion as a
+replayable scenarios/regressions pin.
+
+Usage: python scripts/fault_sweep.py [out_dir]            (static sweep)
+       python scripts/fault_sweep.py [out_dir] --search
+           [--protocol p2pflood] [--objective done_at]
+           [--optimizer es|random|sha] [--generations N]
+           [--population N] [--sim-ms MS] [--seed N] [--pin PATH]
 """
 
 from __future__ import annotations
@@ -34,7 +46,6 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
 
 import numpy as np  # noqa: E402
 
-from wittgenstein_tpu.faults import FaultPlan  # noqa: E402
 from wittgenstein_tpu.protocols.p2pflood import P2PFloodParameters  # noqa: E402
 from wittgenstein_tpu.protocols.p2pflood_batched import make_p2pflood  # noqa: E402
 from wittgenstein_tpu.scenarios.sweep import run_fault_sweep  # noqa: E402
@@ -44,24 +55,79 @@ SIM_MS = 1500
 SEED0 = 0
 
 
-def build_plans(net, state):
-    """Control + four distinct fault lanes on the built population."""
-    n = net.n_nodes
-    live = np.flatnonzero(~np.asarray(state.down))
-    crash_ids = live[len(live) // 4 :][: max(1, len(live) // 5)]  # 20% of live
-    groups = np.arange(n) % 2
-    return [
-        None,  # fault-free control row
-        FaultPlan("crash20@200").crash(crash_ids, at=200),
-        FaultPlan("split@100-600").partition(groups, start=100, end=600),
-        FaultPlan("drop30%").drop(300, start=0),
-        FaultPlan("slow3x").inflate(3000, add_ms=20, start=0),
-    ]
+from wittgenstein_tpu.search.driver import static_baseline_plans  # noqa: E402
+
+# the canonical static 5-plan battery now lives next to the search
+# driver (its champions must strictly beat it); keep the historical
+# script-level name for callers and docs
+build_plans = static_baseline_plans
+
+
+def run_search(argv, out_dir: str) -> int:
+    """--search mode: resumable optimizer campaign (module docstring)."""
+    import argparse
+
+    from wittgenstein_tpu.search import SearchConfig, SearchDriver
+
+    p = argparse.ArgumentParser(prog="fault_sweep.py --search")
+    p.add_argument("--protocol", default="p2pflood")
+    p.add_argument("--objective", default="done_at")
+    p.add_argument("--optimizer", default="es",
+                   choices=("es", "random", "sha"))
+    p.add_argument("--generations", type=int, default=3)
+    p.add_argument("--population", type=int, default=8)
+    p.add_argument("--sim-ms", type=int, default=SIM_MS)
+    p.add_argument("--seed", type=int, default=SEED0)
+    p.add_argument("--pin", default=None,
+                   help="also pin the champion to this regression path")
+    args = p.parse_args(argv)
+
+    cfg = SearchConfig(
+        protocol=args.protocol,
+        objective=args.objective,
+        sim_ms=args.sim_ms,
+        generations=args.generations,
+        population=args.population,
+        seed=args.seed,
+        optimizer=args.optimizer,
+        checkpoint_dir=os.path.join(out_dir, "checkpoints"),
+        label=f"{args.protocol}-{args.optimizer}-s{args.seed}",
+    )
+    driver = SearchDriver(cfg)
+    if driver.generation:
+        print(f"resuming at generation {driver.generation}")
+    report = driver.run()
+    with open(os.path.join(out_dir, "report.json"), "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True, default=float)
+    if args.pin:
+        driver.pin_champion(args.pin)
+    champ = report["champion"]
+    print(
+        json.dumps(
+            {
+                "ok": True,
+                "out_dir": out_dir,
+                "generations": driver.generation,
+                "champion_score": champ["score"] if champ else None,
+                "frontier_size": len(report["frontier"]),
+                "pinned": args.pin,
+            }
+        )
+    )
+    return 0
 
 
 def main() -> int:
-    out_dir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(ROOT, "fault_sweep")
+    argv = sys.argv[1:]
+    out_dir = (
+        argv.pop(0)
+        if argv and not argv[0].startswith("-")
+        else os.path.join(ROOT, "fault_sweep")
+    )
     os.makedirs(out_dir, exist_ok=True)
+    if "--search" in argv:
+        argv.remove("--search")
+        return run_search(argv, out_dir)
 
     net, state = make_p2pflood(P2PFloodParameters(), capacity=2048, seed=SEED0)
     plans = build_plans(net, state)

@@ -35,6 +35,7 @@ from wittgenstein_tpu.engine import replicate_state
 from wittgenstein_tpu.faults import (
     FaultConfig,
     FaultPlan,
+    FaultPlanError,
     lower_plans,
     run_ms_with_plan,
 )
@@ -373,3 +374,40 @@ class TestFaultSweep:
         assert sum(crash["dropped_by_fault"]) == 1
         pongs = np.asarray(out.proto["pong"])[:, 0]
         assert list(pongs) == [N, N - 1]
+
+
+class TestFaultPlanValidation:
+    """Reversed or nonsensical windows must raise the typed
+    FaultPlanError at BUILD time — never lower silently to a no-op lane
+    (a search candidate or pinned regression whose window collapsed
+    would otherwise score as an attack that does nothing)."""
+
+    def test_reversed_crash_window(self):
+        with pytest.raises(FaultPlanError, match="must be > start"):
+            FaultPlan("rev").crash([1], at=500, recover=200)
+
+    def test_empty_crash_window(self):
+        # end == start is a zero-length window, not a one-tick one
+        with pytest.raises(FaultPlanError, match="must be > start"):
+            FaultPlan("empty").crash([1], at=300, recover=300)
+
+    def test_reversed_windows_every_lane(self):
+        groups = np.arange(8) % 2
+        for build in (
+            lambda p: p.partition(groups, start=600, end=100),
+            lambda p: p.drop(300, start=400, end=400),
+            lambda p: p.inflate(2000, start=9, end=3),
+            lambda p: p.silence([2], start=50, end=10),
+            lambda p: p.delay([2], 30, start=7, end=7),
+        ):
+            with pytest.raises(FaultPlanError, match="must be > start"):
+                build(FaultPlan("rev"))
+
+    def test_negative_start(self):
+        with pytest.raises(FaultPlanError, match="must be >= 0"):
+            FaultPlan("neg").silence([0], start=-1)
+
+    def test_is_a_value_error(self):
+        # pre-typed callers that caught ValueError keep working
+        with pytest.raises(ValueError):
+            FaultPlan("rev").crash([1], at=10, recover=5)

@@ -1,0 +1,627 @@
+"""Adversary search: genome/objective/optimizer units, the one-compile-
+per-generation contract, kill-and-resume bitwise champions, pinned
+regression replay (including every checked-in pin), the SL1401 audit,
+and the bench-trend search gate.
+
+The engine-touching tests all ride the p2pflood registry build at short
+horizons; the cached-sweep tests share one row geometry so the whole
+module pays for a handful of compiles.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, ".")
+
+from wittgenstein_tpu.scenarios.regressions import (
+    REGRESSIONS_DIR,
+    list_regressions,
+    load_regression,
+    verify_regression,
+)
+from wittgenstein_tpu.search import (
+    FaultGenome,
+    GeneSpec,
+    GenomeSpec,
+    OBJECTIVES,
+    SearchConfig,
+    SearchDriver,
+    baseline_scores,
+    get_objective,
+    make_optimizer,
+    pareto_frontier,
+    score_records,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _spec2():
+    return GenomeSpec(
+        [GeneSpec("a", 0.0, 1.0), GeneSpec("b", 0.0, 10.0, integer=True)]
+    )
+
+
+# ---------------------------------------------------------------------------
+# genome
+
+
+class TestGenome:
+    def test_gene_bounds_validate(self):
+        with pytest.raises(ValueError):
+            GeneSpec("x", 2.0, 1.0)
+        with pytest.raises(ValueError):
+            GenomeSpec([GeneSpec("a", 0, 1), GeneSpec("a", 0, 1)])
+
+    def test_validate_strict_and_decode_rounds(self):
+        spec = _spec2()
+        with pytest.raises(ValueError, match="shape"):
+            spec.validate([0.5])
+        with pytest.raises(ValueError, match="out of bounds"):
+            spec.validate([0.5, 11.0])
+        with pytest.raises(ValueError, match="non-finite"):
+            spec.validate([np.nan, 1.0])
+        g = spec.decode([0.25, 6.6])
+        assert g == {"a": 0.25, "b": 7}
+        assert isinstance(g["b"], int)
+
+    def test_json_roundtrip(self):
+        spec = _spec2()
+        again = GenomeSpec.from_json(json.loads(json.dumps(spec.to_json())))
+        assert again.names == spec.names
+        assert np.array_equal(again.lo, spec.lo)
+        assert np.array_equal(again.hi, spec.hi)
+        assert [g.integer for g in again.genes] == [False, True]
+
+    def test_random_in_box_and_deterministic(self):
+        spec = _spec2()
+        a = spec.random(np.random.Generator(np.random.PCG64(7)), 50)
+        b = spec.random(np.random.Generator(np.random.PCG64(7)), 50)
+        assert np.array_equal(a, b)
+        assert np.all(a >= spec.lo) and np.all(a <= spec.hi)
+
+    def test_neutral_genome_lowers_to_control(self):
+        g = FaultGenome(1000, 16)
+        vec = g.spec.clip(np.zeros(g.spec.n_genes))
+        # zero crash/part/silence fractions, drop 0, inflation 1000/0:
+        # every lane omitted -> same digest as the no-plan control
+        vec[g.spec.names.index("infl_pm")] = 1000.0
+        vec[g.spec.names.index("crash_dur")] = 1.0
+        vec[g.spec.names.index("part_dur")] = 1.0
+        vec[g.spec.names.index("drop_dur")] = 1.0
+        vec[g.spec.names.index("byz_dur")] = 1.0
+        from wittgenstein_tpu.faults import plan_digest
+
+        assert g.digest(vec, 3) == plan_digest(None, 16, 3)
+
+    def test_crash_block_is_live_contiguous(self):
+        live = np.ones(20, bool)
+        live[:4] = False  # nodes 0-3 statically down
+        g = FaultGenome(500, 20, live=live)
+        vec = g.spec.center()
+        vec[g.spec.names.index("crash_frac")] = 0.25  # 4 of 16 live
+        vec[g.spec.names.index("crash_off")] = 0.0
+        decoded = g.spec.decode(vec)
+        nodes = g._crash_nodes(decoded)
+        assert list(nodes) == [4, 5, 6, 7]  # first live block, never down ids
+        vec[g.spec.names.index("crash_off")] = 1.0
+        nodes = g._crash_nodes(g.spec.decode(vec))
+        assert list(nodes) == [16, 17, 18, 19]
+
+    def test_digest_separates_plans(self):
+        g = FaultGenome(500, 16)
+        a = g.spec.center()
+        b = a.copy()
+        b[g.spec.names.index("drop_pm")] = 999.0
+        assert g.digest(a, 3) != g.digest(b, 3)
+        assert g.digest(a, 3) == g.digest(a.copy(), 3)
+
+
+# ---------------------------------------------------------------------------
+# objectives
+
+
+class TestObjectives:
+    def test_done_at_censors_at_horizon(self):
+        obj = get_objective("done_at")
+        done = {"availability": 1.0, "done_at_ms": {"p90": 400, "max": 450}}
+        undone = {"availability": 0.0, "done_at_ms": None}
+        assert obj(done, 1000) == 400.0
+        assert obj(undone, 1000) == 2000.0  # the objective's ceiling
+        half = {"availability": 0.5, "done_at_ms": {"p90": 800}}
+        assert obj(half, 1000) == 1300.0
+
+    def test_registry_and_unknown(self):
+        assert "done_at" in OBJECTIVES and "reward_ratio" in OBJECTIVES
+        with pytest.raises(KeyError, match="unknown objective"):
+            get_objective("nope")
+
+    def test_score_records_vector(self):
+        recs = [
+            {"availability": 1.0, "done_at_ms": {"p90": 100}},
+            {"availability": 1.0, "done_at_ms": {"p90": 300}},
+        ]
+        s = score_records(recs, "done_at", 1000)
+        assert s.dtype == np.float64 and list(s) == [100.0, 300.0]
+
+    def test_pareto_frontier(self):
+        pts = [(0.0, 100), (0.5, 100), (0.5, 300), (0.2, 50), (0.5, 300)]
+        keep = pareto_frontier(pts)
+        # (0.5,300) dominates everything else; the duplicate ties stay
+        assert keep == [2, 4]
+        assert pareto_frontier([(1.0, 1.0)]) == [0]
+
+
+# ---------------------------------------------------------------------------
+# optimizers
+
+
+class TestOptimizers:
+    def test_make_and_population_floor(self):
+        spec = _spec2()
+        with pytest.raises(KeyError, match="unknown optimizer"):
+            make_optimizer("nope", spec, 4)
+        with pytest.raises(ValueError, match="population"):
+            make_optimizer("random", spec, 1)
+
+    def test_random_deterministic_and_bounded(self):
+        spec = _spec2()
+        a, b = (make_optimizer("random", spec, 8, seed=3) for _ in range(2))
+        pa, pb = a.ask(), b.ask()
+        assert np.array_equal(pa, pb) and pa.shape == (8, 2)
+        assert np.all(pa >= spec.lo) and np.all(pa <= spec.hi)
+
+    def test_tell_strict_improvement_champion(self):
+        opt = make_optimizer("random", _spec2(), 4, seed=0)
+        pop = opt.ask()
+        opt.tell(pop, [1.0, 3.0, 3.0, 2.0])
+        assert opt.best_score == 3.0
+        assert np.array_equal(opt.best_vec, pop[1])  # first argmax on tie
+        pop2 = opt.ask()
+        opt.tell(pop2, [3.0, 3.0, 3.0, 3.0])  # equal, not better
+        assert np.array_equal(opt.best_vec, pop[1])
+
+    def test_es_moves_mean_toward_parents(self):
+        spec = _spec2()
+        opt = make_optimizer("es", spec, 8, seed=1)
+        pop = opt.ask()
+        scores = -np.abs(pop[:, 0] - 1.0)  # favor a -> 1.0
+        before = opt.mean[0]
+        opt.tell(pop, scores)
+        assert opt.mean[0] > before
+
+    def test_sha_geometry_and_restart(self):
+        spec = _spec2()
+        opt = make_optimizer("sha", spec, 8, seed=0)
+        assert opt.rungs == 3
+        rows = []
+        for _ in range(4):
+            pop = opt.ask()
+            rows.append((pop.shape[0], opt.replicas_per_plan(1)))
+            opt.tell(pop, np.arange(pop.shape[0], dtype=float))
+        # candidate count halves, replicas double: constant row product;
+        # after the last rung the ladder restarts with a fresh sample
+        assert rows == [(8, 1), (4, 2), (2, 4), (8, 1)]
+
+    def test_state_roundtrip_bitwise(self):
+        spec = _spec2()
+        for kind in ("random", "es", "sha"):
+            a = make_optimizer(kind, spec, 8, seed=5)
+            for _ in range(2):
+                pop = a.ask()
+                a.tell(pop, pop[:, 0])
+            b = make_optimizer(kind, spec, 8, seed=5)
+            b.load_state(a.state_arrays(), a.state_meta())
+            assert b.generation == a.generation
+            assert b.best_score == a.best_score
+            assert np.array_equal(a.ask(), b.ask()), kind
+
+    def test_load_state_rejects_other_kind(self):
+        spec = _spec2()
+        a = make_optimizer("random", spec, 4)
+        b = make_optimizer("es", spec, 4)
+        with pytest.raises(ValueError, match="optimizer"):
+            b.load_state(a.state_arrays(), a.state_meta())
+
+
+# ---------------------------------------------------------------------------
+# sweep dedupe (satellite: identical plans evaluated once)
+
+
+class TestSweepDedupe:
+    def test_duplicates_fan_out(self):
+        from wittgenstein_tpu.core.registries import registry_batched_protocols
+        from wittgenstein_tpu.faults import FaultPlan
+        from wittgenstein_tpu.scenarios.sweep import (
+            run_fault_sweep,
+            sweep_counters,
+        )
+
+        net, state = registry_batched_protocols.get("p2pflood").factory()
+        plans = [
+            None,
+            FaultPlan("dropA").drop(200, start=0),
+            None,  # duplicate of the control by lowered digest
+            FaultPlan("dropB").drop(200, start=0),  # duplicate of dropA
+        ]
+        before = sweep_counters()
+        out, records = run_fault_sweep(net, state, plans, sim_ms=300)
+        after = sweep_counters()
+        assert after["plans_in"] - before["plans_in"] == 4
+        assert after["plans_evaluated"] - before["plans_evaluated"] == 2
+        assert after["plans_deduped"] - before["plans_deduped"] == 2
+        # out stacks only the unique rows; records fan back out
+        assert np.asarray(out.done_at).shape[0] == 2
+        assert len(records) == 4
+        assert records[0]["plan_digest"] == records[2]["plan_digest"]
+        assert records[1]["plan_digest"] == records[3]["plan_digest"]
+        assert records[0]["seed0_row"] == records[2]["seed0_row"] == 0
+        assert records[1]["seed0_row"] == records[3]["seed0_row"] == 1
+        # the duplicate's stats are the original's, verbatim
+        assert records[1]["done_at_ms"] == records[3]["done_at_ms"]
+        assert records[0]["availability"] == records[2]["availability"]
+
+    def test_distinct_plans_unchanged(self):
+        # all-distinct populations keep pre-dedupe rows and seeds: the
+        # counters book zero dedupes and seed0_row is the row index
+        from wittgenstein_tpu.core.registries import registry_batched_protocols
+        from wittgenstein_tpu.faults import FaultPlan
+        from wittgenstein_tpu.scenarios.sweep import (
+            run_fault_sweep,
+            sweep_counters,
+        )
+
+        net, state = registry_batched_protocols.get("p2pflood").factory()
+        plans = [None, FaultPlan("d").drop(100, start=0)]
+        before = sweep_counters()
+        out, records = run_fault_sweep(net, state, plans, sim_ms=300, seed0=7)
+        after = sweep_counters()
+        assert after["plans_deduped"] - before["plans_deduped"] == 0
+        assert np.asarray(out.done_at).shape[0] == 2
+        assert [r["seed0_row"] for r in records] == [7, 8]
+
+
+# ---------------------------------------------------------------------------
+# driver: compile discipline, resume, pinning
+
+
+def _cfg(**kw):
+    base = dict(
+        protocol="p2pflood", objective="done_at", sim_ms=400,
+        generations=3, population=4, seed=0, optimizer="es",
+        label="test-search",
+    )
+    base.update(kw)
+    return SearchConfig(**base)
+
+
+class TestSearchDriver:
+    def test_one_compile_per_generation(self):
+        from wittgenstein_tpu.parallel.replica_shard import run_cache_info
+
+        d = SearchDriver(_cfg(label="compile-test"))
+        d.run_generation()
+        compiles = run_cache_info()["compiles"]
+        hits = run_cache_info()["hits"]
+        d.run_generation()
+        d.run_generation()
+        info = run_cache_info()
+        # the contract: generations after warm-up are pure cache hits
+        assert info["compiles"] == compiles, "extra XLA compile after gen 1"
+        assert info["hits"] >= hits + 2
+        assert d.generation == 3
+        assert d.champion is not None and len(d.history) == 3
+
+    def test_kill_and_resume_bitwise_champion(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        cfg = _cfg(label="resume", checkpoint_dir=ck)
+        d1 = SearchDriver(cfg)
+        d1.run_generation()  # "killed" here: nothing else persists
+        d2 = SearchDriver(cfg)  # fresh construction = process restart
+        assert d2.generation == 1
+        rep_resumed = d2.run()
+        rep_clean = SearchDriver(_cfg(label="resume")).run()
+        a, b = rep_resumed["champion"], rep_clean["champion"]
+        assert a["score"] == b["score"]
+        assert a["vec"] == b["vec"]
+        assert a["plan_digest"] == b["plan_digest"]
+        # per-generation trajectory matches on every deterministic field
+        # (eval_s is wall-clock and excluded)
+        det = ("gen", "evals", "replicas_per_plan", "best_gen_score",
+               "champion_score")
+        assert [
+            {k: r[k] for k in det} for r in rep_resumed["history"]
+        ] == [{k: r[k] for k in det} for r in rep_clean["history"]]
+
+    def test_resume_refuses_other_config(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        d1 = SearchDriver(_cfg(label="cfg-a", checkpoint_dir=ck))
+        d1.run_generation()
+        with pytest.raises(ValueError, match="different search config"):
+            SearchDriver(_cfg(label="cfg-b", checkpoint_dir=ck))
+
+    def test_pin_and_bitwise_replay(self, tmp_path):
+        d = SearchDriver(_cfg(label="pin-test", generations=2))
+        d.run()
+        pin = str(tmp_path / "champ.json")
+        doc = d.pin_champion(pin)
+        assert doc["schema"] == "witt-regression/v1"
+        loaded = load_regression(pin)
+        assert loaded == doc
+        out = verify_regression(pin, check_baseline=False)
+        assert out["objective_value"] == d.champion["score"]
+        assert out["plan_digest"] == d.champion["plan_digest"]
+
+    def test_report_and_frontier_shape(self):
+        d = SearchDriver(_cfg(label="report-test", generations=1))
+        rep = d.run()
+        assert rep["schema"] == "witt-search-report/v1"
+        front = rep["frontier"]
+        assert front, "one generation must yield a non-empty frontier"
+        # every reported frontier point is itself non-dominated
+        vals = [(p["unavailability"], p["done_p90"]) for p in front]
+        assert pareto_frontier(vals) == list(range(len(vals)))
+        assert {"gen", "score", "plan_digest"} <= set(front[0])
+
+
+# ---------------------------------------------------------------------------
+# checked-in pins: the discovered attacks stay regressions
+
+
+class TestCheckedInRegressions:
+    def test_pins_exist_for_two_protocols(self):
+        pins = list_regressions()
+        protos = {load_regression(p)["protocol"] for p in pins}
+        assert "p2pflood" in protos
+        assert protos & {"handel", "casper"}, (
+            "need a pinned champion for a second protocol"
+        )
+
+    def test_p2pflood_pin_replays_bitwise(self):
+        [pin] = [
+            p for p in list_regressions()
+            if load_regression(p)["protocol"] == "p2pflood"
+        ]
+        out = verify_regression(pin)  # baseline dominance re-asserted too
+        assert out["baseline_scores"], "pin must carry its beaten baselines"
+
+    @pytest.mark.slow
+    def test_other_pins_replay_bitwise(self):
+        pins = [
+            p for p in list_regressions()
+            if load_regression(p)["protocol"] != "p2pflood"
+        ]
+        assert pins
+        for pin in pins:
+            verify_regression(pin)
+
+
+# ---------------------------------------------------------------------------
+# SL1401: the pinned-regression audit
+
+
+class TestSL1401:
+    @staticmethod
+    def _tree(tmp_path, doc):
+        d = tmp_path / "wittgenstein_tpu" / "scenarios" / "regressions"
+        d.mkdir(parents=True)
+        (d / "bad.json").write_text(
+            doc if isinstance(doc, str) else json.dumps(doc)
+        )
+        return str(tmp_path)
+
+    @staticmethod
+    def _good_doc():
+        # structurally valid: registered protocol, known objective,
+        # in-bounds genome, beaten baseline
+        g = FaultGenome(500, 16)
+        vec = [float(x) for x in g.spec.center()]
+        return {
+            "schema": "witt-regression/v1",
+            "label": "t", "protocol": "p2pflood", "objective": "done_at",
+            "sim_ms": 500, "seed0": 0, "replicas_per_plan": 1,
+            "genome": {"vec": vec, "spec": g.spec.to_json()},
+            "plan_digest": "0" * 32, "objective_value": 2.0,
+            "baseline": {"seed0": 0, "scores": {"control": 1.0}},
+        }
+
+    def test_whole_tree_clean(self):
+        from wittgenstein_tpu.analysis.regressions_check import (
+            check_regressions,
+        )
+
+        assert check_regressions(ROOT, lower=False) == []
+
+    def test_structural_findings(self, tmp_path):
+        from wittgenstein_tpu.analysis.regressions_check import (
+            check_regressions,
+        )
+
+        cases = {
+            "not json {": "does not load as JSON",
+            json.dumps({"schema": "witt-regression/v1"}): "missing required",
+        }
+        doc = self._good_doc()
+        doc["protocol"] = "not-a-protocol"
+        cases[json.dumps(doc)] = "not a registered"
+        doc = self._good_doc()
+        doc["genome"]["vec"][0] = 99.0  # out of bounds
+        cases[json.dumps(doc)] = "does not validate"
+        doc = self._good_doc()
+        doc["objective_value"] = 0.5  # does not beat its baseline
+        cases[json.dumps(doc)] = "strictly beat"
+        for i, (raw, needle) in enumerate(cases.items()):
+            root = self._tree(tmp_path / f"case{i}", raw)
+            found = check_regressions(root, lower=False)
+            assert found and all(f.rule == "SL1401" for f in found)
+            assert any(needle in f.message for f in found), needle
+
+    def test_lowering_depth_catches_digest_drift(self, tmp_path):
+        from wittgenstein_tpu.analysis.regressions_check import (
+            check_regressions,
+        )
+
+        doc = self._good_doc()  # plan_digest is a fabricated zero string
+        # rebuild the genome against the real registry build so only the
+        # digest is wrong
+        root = self._tree(tmp_path, json.dumps(doc))
+        assert check_regressions(root, lower=False) == []
+        found = check_regressions(root, lower=True)
+        assert len(found) == 1 and "digest" in found[0].message
+
+    def test_rule_registered(self):
+        from wittgenstein_tpu.analysis.findings import RULES
+
+        assert "SL1401" in RULES
+
+
+# ---------------------------------------------------------------------------
+# bench trend: the search throughput gate
+
+
+class TestBenchTrendSearchGate:
+    @pytest.fixture(scope="class")
+    def bench_trend(self):
+        return _load_script("bench_trend")
+
+    @staticmethod
+    def _trend(search):
+        return {
+            "floor": {"node_count": 1, "n_replicas": 1, "floor": 0.5},
+            "latest_comparable": {"round": 1, "sims_per_sec": 1.0},
+            "regressions": [],
+            "search": search,
+        }
+
+    @staticmethod
+    def _search_record(**kw):
+        rec = {
+            "schema": "witt-bench-search/v1", "ok": True,
+            "evals_per_sec": 0.3, "evals_per_sec_floor": 0.05,
+            "champion_trajectory": [1.0, 2.0, 2.0],
+        }
+        rec.update(kw)
+        return rec
+
+    def test_good_record_passes(self, bench_trend):
+        assert bench_trend.check(self._trend(self._search_record())) == []
+
+    def test_unknown_schema_fails(self, bench_trend):
+        probs = bench_trend.check(
+            self._trend(self._search_record(schema="witt-bench-search/v9"))
+        )
+        assert any("unknown schema" in p for p in probs)
+
+    def test_not_ok_fails(self, bench_trend):
+        probs = bench_trend.check(
+            self._trend(self._search_record(ok=False, failures=["boom"]))
+        )
+        assert any("failed adversary smoke" in p for p in probs)
+
+    def test_below_floor_fails(self, bench_trend):
+        probs = bench_trend.check(
+            self._trend(self._search_record(evals_per_sec=0.01))
+        )
+        assert any("below its documented floor" in p for p in probs)
+
+    def test_decreasing_trajectory_fails(self, bench_trend):
+        probs = bench_trend.check(
+            self._trend(
+                self._search_record(champion_trajectory=[2.0, 1.5, 3.0])
+            )
+        )
+        assert any("champion_trajectory decreases" in p for p in probs)
+
+    def test_committed_record_is_gate_clean(self, bench_trend):
+        with open(os.path.join(ROOT, "BENCH_SEARCH.json")) as f:
+            rec = json.load(f)
+        assert bench_trend.check(self._trend(rec)) == []
+
+
+# ---------------------------------------------------------------------------
+# env policy path
+
+
+class TestAttackEnv:
+    def test_pingpong_mechanics(self):
+        from wittgenstein_tpu.protocols.handel_env import BatchedAttackEnv
+        from wittgenstein_tpu.protocols.pingpong_batched import make_pingpong
+
+        net, state = make_pingpong(
+            16, network_latency_name="NetworkFixedLatency(100)"
+        )
+        env = BatchedAttackEnv(
+            net=net, state=state, n_replicas=2, decision_ms=150,
+            horizon_ms=300,
+        )
+        obs = env.reset()
+        assert obs["time"].shape == (2,)
+        assert np.all(obs["time"] == 0)
+        with_silence = []
+        for acts in ([1, 1], [0, 0]):
+            env.reset()
+            env.step(np.array(acts))
+            o, r, info = env.step(np.array(acts))
+            assert np.all(o["time"] == 300)
+            assert r.shape == (2,)
+            with_silence.append(float(o["msg_received_mean"].sum()))
+        # a silent adversary bloc emits nothing: strictly less traffic
+        assert with_silence[0] < with_silence[1]
+
+    def test_step_before_reset_raises(self):
+        from wittgenstein_tpu.protocols.handel_env import BatchedAttackEnv
+        from wittgenstein_tpu.protocols.pingpong_batched import make_pingpong
+
+        net, state = make_pingpong(
+            16, network_latency_name="NetworkFixedLatency(100)"
+        )
+        env = BatchedAttackEnv(
+            net=net, state=state, n_replicas=2, decision_ms=100,
+            horizon_ms=200,
+        )
+        with pytest.raises(RuntimeError, match="reset"):
+            env.step(np.zeros(2))
+
+    def test_sha_rejected_for_env_policy(self):
+        from wittgenstein_tpu.protocols.handel_env import BatchedAttackEnv
+        from wittgenstein_tpu.protocols.pingpong_batched import make_pingpong
+        from wittgenstein_tpu.search import optimize_env_policy
+
+        net, state = make_pingpong(
+            16, network_latency_name="NetworkFixedLatency(100)"
+        )
+        env = BatchedAttackEnv(
+            net=net, state=state, n_replicas=4, decision_ms=100,
+            horizon_ms=200,
+        )
+        with pytest.raises(ValueError, match="fixed population"):
+            optimize_env_policy(env, optimizer="sha")
+
+    @pytest.mark.slow
+    def test_handel_policy_optimization(self):
+        from wittgenstein_tpu.protocols.handel_env import BatchedAttackEnv
+        from wittgenstein_tpu.search import optimize_env_policy
+
+        env = BatchedAttackEnv(
+            n_replicas=4, decision_ms=200, horizon_ms=600, seed=0
+        )
+        opt = optimize_env_policy(env, generations=2, seed=0, optimizer="es")
+        assert opt.generation == 2
+        assert opt.best_vec is not None
+        assert 0.0 <= opt.best_score <= 1.0

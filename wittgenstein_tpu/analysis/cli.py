@@ -7,19 +7,21 @@ Runs up to ten passes and prints findings as `path:line: RULE [sev] msg`
   2. registry/test coverage meta-rule           (SL301)
   3. SLO alert catalog audit                    (SL1101)
   4. concurrency contract checker               (SL1301-SL1307)
-  5. abstract-eval contract checks              (SL401-SL404)
-  6. beat RNG audit                             (SL405)
-  7. checkpoint completeness                    (SL501)
-  8. phase-annotation presence + neutrality     (SL601)
-  9. serve scheduler batching contract          (SL801)
- 10. 2D-mesh replicated-leaf audit              (SL1001)
+  5. pinned-regression audit                    (SL1401)
+  6. abstract-eval contract checks              (SL401-SL404)
+  7. beat RNG audit                             (SL405)
+  8. checkpoint completeness                    (SL501)
+  9. phase-annotation presence + neutrality     (SL601)
+ 10. serve scheduler batching contract          (SL801)
+ 11. 2D-mesh replicated-leaf audit              (SL1001)
 
 Exit status: 0 when clean; 1 when any ERROR finding (or, with --strict,
-any finding at all) survives suppression; 2 on usage errors.  Passes 5-9
+any finding at all) survives suppression; 2 on usage errors.  Passes 6-10
 build every registered protocol and trace real kernels, so they take tens
 of seconds — `--skip-contracts` runs just the fast text-level passes
-(1-4; no JAX import); `--skip-concurrency` drops the lock-discipline
-pass from either mode.
+(1-5; no JAX import; the SL1401 audit then checks structure only,
+skipping its plan-lowering depth); `--skip-concurrency` drops the
+lock-discipline pass from either mode.
 """
 
 from __future__ import annotations
@@ -87,6 +89,13 @@ def run(root: str, skip_contracts: bool = False,
         from .concurrency_check import check_concurrency
 
         findings += check_concurrency(root)
+    if skip_contracts:
+        from .regressions_check import check_regressions
+
+        # pinned-regression audit (SL1401) at structural depth — the
+        # lowering depth runs in the contracts block below instead (one
+        # call either way, so a bad pin is reported exactly once)
+        findings += check_regressions(root, lower=False)
     findings = [
         dataclasses.replace(f, path=_rel(f.path, root)) for f in findings
     ]
@@ -122,6 +131,12 @@ def run(root: str, skip_contracts: bool = False,
         from .mesh_check import check_mesh_layout
 
         findings += check_mesh_layout(root=root, names=protocols)
+        from .regressions_check import check_regressions
+
+        findings += [
+            dataclasses.replace(f, path=_rel(f.path, root))
+            for f in check_regressions(root, lower=True)
+        ]
     return findings
 
 

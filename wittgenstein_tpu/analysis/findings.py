@@ -135,6 +135,11 @@ RULES: Dict[str, str] = {
     "SL1307": "yield-point catalog drift: a yield_point() call site names "
     "a point missing from YIELD_POINTS, or a catalog entry has no call "
     "site left in the tree",
+    "SL1401": "pinned-regression audit: a scenarios/regressions/*.json "
+    "attack pin fails to load, names an unregistered protocol or unknown "
+    "objective, carries a genome outside its declared bounds, no longer "
+    "strictly beats its pinned baselines, or (contracts mode) lowers to "
+    "a FaultState whose digest differs from the pinned plan_digest",
 }
 
 

@@ -1,0 +1,114 @@
+"""simlint SL1401: the pinned-regression audit.
+
+`scenarios/regressions/*.json` files are executable claims: each one
+says "this genome, lowered against THIS registered protocol, scored
+THIS value and beat the static baselines" — and tests/CI replay them
+bitwise.  A pin that no longer loads, names an unregistered protocol or
+unknown objective, or carries a genome outside its own declared bounds
+is a regression test that silently stopped testing anything.
+
+Two depths, matching the CLI's fast/contracts split:
+
+  - structural (`lower=False`, part of `--skip-contracts`): JSON loads,
+    schema/required fields, protocol registered in
+    core.registries.registry_batched_protocols, objective registered in
+    search.objectives.OBJECTIVES, genome validates against its pinned
+    GeneSpec bounds, and a pinned baseline block is strictly beaten by
+    the pinned objective value.  No JAX import anywhere on this path.
+  - lowering (`lower=True`, contracts mode): additionally rebuild the
+    (net, state) from the registry factory, decode the genome against
+    the live mask, lower the plan, and require the lowered FaultState
+    digest to equal the pinned `plan_digest` — the "still means the
+    same attack" check.  The full bitwise SCORE replay stays in
+    tests/test_search.py and scripts/adversary_smoke.py (it runs the
+    engine; too slow for a lint pass).
+
+Findings anchor at line 1 of the offending file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List
+
+from .findings import Finding, Severity
+
+RULE = "SL1401"
+
+
+def _finding(path: str, msg: str) -> Finding:
+    return Finding(rule=RULE, path=path, line=1, message=msg,
+                   severity=Severity.ERROR)
+
+
+def check_regressions(root: str, lower: bool = False) -> List[Finding]:
+    """Audit every checked-in regression pin under `root` (see module
+    docstring for the two depths)."""
+    from ..scenarios.regressions import check_regression_doc
+
+    reg_dir = os.path.join(
+        root, "wittgenstein_tpu", "scenarios", "regressions"
+    )
+    findings: List[Finding] = []
+    if not os.path.isdir(reg_dir):
+        return findings
+    for name in sorted(os.listdir(reg_dir)):
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(reg_dir, name)
+        try:
+            doc = json.loads(open(path, encoding="utf-8").read())
+        except (OSError, json.JSONDecodeError) as e:
+            findings.append(_finding(path, f"does not load as JSON: {e}"))
+            continue
+        if not isinstance(doc, dict):
+            findings.append(
+                _finding(path, "top-level JSON value is not an object")
+            )
+            continue
+        for problem in check_regression_doc(doc):
+            findings.append(_finding(path, problem))
+        if lower and not check_regression_doc(doc):
+            findings.extend(_check_lowering(path, doc))
+    return findings
+
+
+def _check_lowering(path: str, doc: dict) -> List[Finding]:
+    import numpy as np
+
+    from ..core.registries import registry_batched_protocols
+    from ..search.genome import FaultGenome
+
+    try:
+        net, state = registry_batched_protocols.get(doc["protocol"]).factory()
+    except NotImplementedError:
+        # registered name without a batched factory yet (ethpow's
+        # stub): structural checks passed, nothing to lower against
+        return []
+    try:
+        genome = FaultGenome(
+            doc["sim_ms"], net.n_nodes, live=~np.asarray(state.down)
+        )
+        digest = genome.digest(
+            np.asarray(doc["genome"]["vec"], np.float64),
+            net.protocol.n_msg_types(),
+        )
+    except Exception as e:  # any decode/lower failure is the finding
+        return [
+            _finding(
+                path,
+                f"pinned genome fails to lower against the rebuilt "
+                f"{doc['protocol']!r} state: {e}",
+            )
+        ]
+    if digest != doc["plan_digest"]:
+        return [
+            _finding(
+                path,
+                f"lowered-plan digest {digest} != pinned "
+                f"{doc['plan_digest']} — the pin no longer names the "
+                "attack it was frozen from",
+            )
+        ]
+    return []

@@ -10,7 +10,13 @@ See docs/faults.md.
 """
 
 from .oracle_hooks import crash_edges, run_ms_with_plan, start_nodes, stop_nodes
-from .plan import FaultPlan, lower_plans
+from .plan import (
+    FaultPlan,
+    FaultPlanError,
+    fault_state_digest,
+    lower_plans,
+    plan_digest,
+)
 from .state import (
     FAULT_STREAM,
     FaultConfig,
@@ -27,11 +33,14 @@ __all__ = [
     "FAULT_STREAM",
     "FaultConfig",
     "FaultPlan",
+    "FaultPlanError",
     "FaultState",
     "crash_edges",
     "deliver_suppress",
+    "fault_state_digest",
     "inflate_latency",
     "lower_plans",
+    "plan_digest",
     "neutral_fault_state",
     "node_crashed",
     "run_ms_with_plan",

@@ -25,6 +25,7 @@ time, where n_nodes / n_msg_types are known.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -32,13 +33,23 @@ import numpy as np
 from .state import INT_MAX, FaultState, neutral_fault_state, stack_fault_states
 
 
+class FaultPlanError(ValueError):
+    """A fault plan that cannot mean what it says: reversed windows
+    (crash at or after recovery, partition end before start), rates
+    outside their domain, or nodes/mtypes outside the population.
+    Raised at BUILD time wherever possible (window ordering does not
+    need n_nodes), and at lower() time for the population-sized checks
+    — never silently lowered to a no-op lane.  Subclasses ValueError so
+    pre-typed callers keep catching it."""
+
+
 def _window(start, end, what: str) -> Tuple[int, int]:
     start = int(start)
     end = int(INT_MAX) if end is None else int(end)
     if start < 0:
-        raise ValueError(f"{what}: start={start} must be >= 0")
+        raise FaultPlanError(f"{what}: start={start} must be >= 0")
     if end <= start:
-        raise ValueError(f"{what}: end={end} must be > start={start}")
+        raise FaultPlanError(f"{what}: end={end} must be > start={start}")
     return start, end
 
 
@@ -77,7 +88,7 @@ class FaultPlan:
         maps node id -> group id (any int labels); cross-group messages
         are dropped at send and on arrival while active."""
         if self._partition is not None:
-            raise ValueError(f"{self.label}: partition() already set")
+            raise FaultPlanError(f"{self.label}: partition() already set")
         start, end = _window(start, end, f"partition({self.label})")
         self._partition = (np.asarray(groups), start, end)
         return self
@@ -88,10 +99,10 @@ class FaultPlan:
         from a dedicated RNG stream (base latency draws untouched).
         mtypes=None applies to every message type."""
         if self._drop is not None:
-            raise ValueError(f"{self.label}: drop() already set")
+            raise FaultPlanError(f"{self.label}: drop() already set")
         per_mille = int(per_mille)
         if not 0 <= per_mille <= 1000:
-            raise ValueError(
+            raise FaultPlanError(
                 f"drop({self.label}): per_mille={per_mille} outside [0,1000]"
             )
         start, end = _window(start, end, f"drop({self.label})")
@@ -104,10 +115,10 @@ class FaultPlan:
         multiplier_pm // 1000 + add_ms (per-mille multiplier; 2000 =
         2x).  mtypes=None applies to every message type."""
         if self._inflate is not None:
-            raise ValueError(f"{self.label}: inflate() already set")
+            raise FaultPlanError(f"{self.label}: inflate() already set")
         multiplier_pm, add_ms = int(multiplier_pm), int(add_ms)
         if multiplier_pm < 0 or add_ms < 0:
-            raise ValueError(
+            raise FaultPlanError(
                 f"inflate({self.label}): multiplier_pm/add_ms must be >= 0"
             )
         start, end = _window(start, end, f"inflate({self.label})")
@@ -119,7 +130,7 @@ class FaultPlan:
         counters still tick — observers cannot tell a silent node from
         a lossy link, which is the point)."""
         if self._silence is not None:
-            raise ValueError(f"{self.label}: silence() already set")
+            raise FaultPlanError(f"{self.label}: silence() already set")
         start, end = _window(start, end, f"silence({self.label})")
         self._silence = (tuple(int(i) for i in nodes), start, end)
         return self
@@ -129,10 +140,10 @@ class FaultPlan:
         """Byzantine delay: every message `nodes` send while active
         arrives delay_ms later than the latency model sampled."""
         if self._delay is not None:
-            raise ValueError(f"{self.label}: delay() already set")
+            raise FaultPlanError(f"{self.label}: delay() already set")
         delay_ms = int(delay_ms)
         if delay_ms < 0:
-            raise ValueError(f"delay({self.label}): delay_ms must be >= 0")
+            raise FaultPlanError(f"delay({self.label}): delay_ms must be >= 0")
         start, end = _window(start, end, f"delay({self.label})")
         self._delay = (tuple(int(i) for i in nodes), delay_ms, start, end)
         return self
@@ -141,7 +152,7 @@ class FaultPlan:
     def _check_nodes(self, nodes, n_nodes, what):
         for i in nodes:
             if not 0 <= i < n_nodes:
-                raise ValueError(
+                raise FaultPlanError(
                     f"{what}({self.label}): node {i} outside [0,{n_nodes})"
                 )
 
@@ -151,7 +162,7 @@ class FaultPlan:
         rows = [int(m) for m in mtypes]
         for m in rows:
             if not 0 <= m < n_msg_types:
-                raise ValueError(
+                raise FaultPlanError(
                     f"{what}({self.label}): mtype {m} outside "
                     f"[0,{n_msg_types})"
                 )
@@ -191,7 +202,7 @@ class FaultPlan:
         if self._partition is not None:
             groups, start, end = self._partition
             if groups.shape != (n_nodes,):
-                raise ValueError(
+                raise FaultPlanError(
                     f"partition({self.label}): groups shape {groups.shape} "
                     f"!= ({n_nodes},)"
                 )
@@ -224,7 +235,7 @@ class FaultPlan:
             byz_windows.append((start, end))
         if byz_windows:
             if len(set(byz_windows)) > 1:
-                raise ValueError(
+                raise FaultPlanError(
                     f"{self.label}: silence() and delay() share one "
                     f"Byzantine window; got {byz_windows}"
                 )
@@ -281,3 +292,31 @@ def lower_plans(plans, n_nodes: int, n_msg_types: int) -> FaultState:
         for p in plans
     ]
     return stack_fault_states(lowered)
+
+
+def fault_state_digest(fs: FaultState) -> str:
+    """Stable content digest of one lowered schedule: field names, leaf
+    dtypes/shapes, and bytes, hashed in field order.  Two plans with the
+    same digest produce bit-identical FaultState rows, so the digest is
+    the dedupe/pin identity for sweeps and regression scenarios (the
+    label is narrative, the digest is the plan)."""
+    h = hashlib.blake2b(digest_size=16)
+    for name, leaf in zip(fs._fields, fs):
+        a = np.asarray(leaf)
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def plan_digest(plan: Optional["FaultPlan"], n_nodes: int,
+                n_msg_types: int) -> str:
+    """fault_state_digest of `plan` lowered at this population size
+    (None = the neutral control schedule)."""
+    fs = (
+        neutral_fault_state(n_nodes, n_msg_types)
+        if plan is None
+        else plan.lower(n_nodes, n_msg_types)
+    )
+    return fault_state_digest(fs)

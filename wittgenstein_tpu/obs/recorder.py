@@ -107,6 +107,11 @@ KNOWN_KINDS = (
     "slo-resolved",
     "invariant-violation",
     "lock-order-violation",
+    # adversary search campaigns (search/driver.py)
+    "search-generation",
+    "search-resume",
+    "search-complete",
+    "search-pinned",
 )
 
 

@@ -1,0 +1,147 @@
+"""BatchedAttackEnv: the Handel Byzantine attacker as a vectorized env.
+
+The ethpow BatchedMinerEnv precedent (ethpow_env.py) turned the selfish
+miner into R lockstep replicas stepping one jitted device program; this
+module does the same for an IN-PROTOCOL Handel adversary, closing the
+search package's second loop: the same optimizers that discover
+FaultPlans (search/optimizers.py) attack a sequential adversary POLICY.
+
+The adversary controls a fixed bloc of live aggregators (the top of the
+live list, matching search.genome.FaultGenome's silence bloc).  At every
+`decision_ms` boundary the policy chooses, per replica, whether the bloc
+is SILENT for the coming step — withholding its signatures and relaying
+nothing — or participates honestly.  Mechanically the toggle is pure
+fault-lane data: the replica's Byzantine-silence window scalars flip
+between [0, INT_MAX) (active) and [INT_MAX, ...) (never), so the
+transition stays ONE jitted program for all R replicas and recompiles
+for nothing — the policy's choices are state, exactly like the fault
+sweep's schedules.
+
+Reward is the ATTACKER's objective: the fraction of statically-live
+nodes whose aggregation is still incomplete (higher = stronger attack),
+matching the `reward_ratio` objective in search/objectives.py — so
+`search.driver.optimize_env_policy(env)` optimizes silence-window
+policies with the identical ask/tell machinery, one rollout generation
+per batched pass, each replica carrying one candidate policy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BatchedAttackEnv:
+    """R lockstep Handel-attacker environments in one device program."""
+
+    def __init__(
+        self,
+        net=None,
+        state=None,
+        n_replicas: int = 8,
+        decision_ms: int = 100,
+        horizon_ms: int = 1000,
+        n_silent: Optional[int] = None,
+        seed: int = 0,
+    ):
+        from ..faults import FaultConfig
+        from ..faults.state import INT_MAX
+
+        if (net is None) != (state is None):
+            raise ValueError("pass both of (net, state) or neither")
+        if net is None:
+            from ..core.registries import registry_batched_protocols
+
+            net, state = registry_batched_protocols.get("handel").factory()
+        if decision_ms <= 0:
+            raise ValueError(f"decision_ms={decision_ms} must be positive")
+        if horizon_ms % decision_ms != 0:
+            raise ValueError(
+                f"horizon_ms={horizon_ms} must be a multiple of "
+                f"decision_ms={decision_ms}"
+            )
+        self.n_replicas = int(n_replicas)
+        self.decision_ms = int(decision_ms)
+        self.horizon_ms = int(horizon_ms)
+        self.seed = int(seed)
+
+        self.net, self._fstate = net.with_faults(state, FaultConfig())
+        live = np.flatnonzero(~np.asarray(state.down))
+        if n_silent is None:
+            n_silent = max(1, len(live) // 5)
+        if not 0 < n_silent <= len(live):
+            raise ValueError(
+                f"n_silent={n_silent} outside (0, live={len(live)}]"
+            )
+        # the adversary bloc: top of the live list, the same selection
+        # FaultGenome._silence_nodes makes — so a policy discovered here
+        # and a silence-lane FaultPlan talk about the same nodes
+        self.silent_nodes = live[len(live) - int(n_silent):]
+        self._states = None
+
+        fnet, dms = self.net, self.decision_ms
+        never = jnp.asarray(INT_MAX, jnp.int32)
+
+        def transition(states, actions):
+            on = actions.astype(bool)  # [R]: silent for this step?
+            fs = states.faults._replace(
+                byz_start=jnp.where(on, jnp.int32(0), never),
+                byz_end=jnp.broadcast_to(never, on.shape),
+            )
+            return fnet._run_ms_batched_impl(
+                states._replace(faults=fs), dms, False
+            )
+
+        self._transition = jax.jit(transition)
+
+    # -- gym-style surface ---------------------------------------------------
+    def _observe(self, states):
+        down = np.asarray(states.down)
+        done = np.asarray(states.done_at)
+        live = ~down
+        n_live = np.maximum(live.sum(axis=1), 1)
+        done_frac = ((done > 0) & live).sum(axis=1) / n_live
+        return {
+            "time": np.asarray(states.time),
+            "done_frac": done_frac,
+            "undone_frac": 1.0 - done_frac,
+            "msg_received_mean": np.where(
+                live, np.asarray(states.msg_received), 0
+            ).sum(axis=1)
+            / n_live,
+        }
+
+    def reset(self):
+        from ..engine.core import replicate_state
+
+        st = self._fstate._replace(
+            faults=self._fstate.faults._replace(
+                byz_silent=jnp.zeros(self.net.n_nodes, bool)
+                .at[jnp.asarray(self.silent_nodes)]
+                .set(True)
+            )
+        )
+        self._states = replicate_state(
+            st,
+            self.n_replicas,
+            seeds=np.arange(self.seed, self.seed + self.n_replicas),
+        )
+        return self._observe(self._states)
+
+    def step(self, actions):
+        """actions: int/bool array [R] — 1 = adversary bloc silent for
+        the coming `decision_ms`.  Returns (obs, reward, info); reward
+        is the live-node undone fraction (attacker maximizes)."""
+        if self._states is None:
+            raise RuntimeError("call reset() first")
+        acts = jnp.asarray(actions, jnp.int32).reshape(self.n_replicas)
+        self._states = self._transition(self._states, acts)
+        obs = self._observe(self._states)
+        return obs, obs["undone_frac"], {"time": obs["time"]}
+
+    @property
+    def states(self):
+        return self._states

@@ -7,8 +7,48 @@ loop.  Here a whole sweep — (configuration x replica) — is ONE stacked
 batched computation (`jax.vmap` over the leading axis), reduced to
 BasicStats rows on the device and emitted as the same CSV shape the
 reference prints.
+
+Pinned adversary regressions (regressions.py) ride along: discovered
+attacks frozen as replayable `scenarios/regressions/*.json` files.
+
+Attribute access is LAZY (PEP 562): `regressions`'s structural half is
+part of simlint's JAX-free fast pass (rule SL1401), so importing this
+package must not pull `sweep`'s JAX dependency until a sweep symbol is
+actually touched.
 """
 
-from .sweep import BasicStats, SweepConfig, run_sweep
+_SWEEP = (
+    "BasicStats",
+    "SweepConfig",
+    "run_sweep",
+    "run_fault_sweep",
+    "sweep_counters",
+    "SWEEP_COUNTERS",
+)
+_REGRESSIONS = (
+    "SCHEMA",
+    "REGRESSIONS_DIR",
+    "pin_regression",
+    "load_regression",
+    "list_regressions",
+    "check_regression_doc",
+    "verify_regression",
+)
 
-__all__ = ["BasicStats", "SweepConfig", "run_sweep"]
+__all__ = sorted(_SWEEP + _REGRESSIONS)
+
+
+def __getattr__(name):
+    if name in _SWEEP:
+        from . import sweep
+
+        return getattr(sweep, name)
+    if name in _REGRESSIONS:
+        from . import regressions
+
+        return getattr(regressions, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

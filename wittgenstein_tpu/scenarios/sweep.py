@@ -197,6 +197,21 @@ def run_sweep(
     return [results[i] for i in range(len(configs))]
 
 
+# Monotonic dedupe accounting for run_fault_sweep: identical plans in
+# one population (degenerate ES generations, converged SHA rungs) are
+# evaluated ONCE and their records fanned back out; these counters are
+# the observable for that contract (tests assert the deltas).
+SWEEP_COUNTERS = {
+    "plans_in": 0,
+    "plans_evaluated": 0,
+    "plans_deduped": 0,
+}
+
+
+def sweep_counters() -> Dict[str, int]:
+    return dict(SWEEP_COUNTERS)
+
+
 def run_fault_sweep(
     net,
     state,
@@ -210,6 +225,7 @@ def run_fault_sweep(
     checkpoint_dir: Optional[str] = None,
     chunk_ms: Optional[int] = None,
     supervisor_kw: Optional[dict] = None,
+    use_run_cache: bool = False,
 ):
     """The fault-axis sweep: one `run_ms_batched` call where replica row
     `r` runs fault plan `plans[r // replicas_per_plan]` (None entries =
@@ -231,22 +247,72 @@ def run_fault_sweep(
     tick count); keep stop_when_done=False for the bitwise claim — the
     early exit is chunk-boundary dependent).  A controlled partial stop
     (supervisor_kw budget_s / max_chunks_this_run) raises
-    RunIncompleteError carrying the partial RunReport."""
+    RunIncompleteError carrying the partial RunReport.
+
+    Identical plans within one population are DEDUPED by lowered-plan
+    digest: each distinct schedule runs once (its `replicas_per_plan`
+    rows, seeded at its FIRST occurrence's position) and the resulting
+    record is fanned back out to every duplicate, so a degenerate
+    optimizer generation does not waste replica rows.  `out` therefore
+    stacks `n_unique * replicas_per_plan` rows; each record carries its
+    `plan_digest` and the `seed0_row` its first evaluated row ran with
+    (the seed a single-plan bitwise replay must pass as seed0).  With
+    all plans distinct — every existing caller — rows, seeds, and
+    results are unchanged.
+
+    use_run_cache evaluates through parallel.replica_shard's cached
+    compiled-program path (sharded_run_stats) instead of a direct
+    run_ms_batched call: repeated sweeps of the same (protocol, sim_ms,
+    row geometry) — an optimizer generation per call — are run-cache
+    HITS, observable on run_cache_info()'s hits/misses/compiles
+    counters (the one-compile-per-generation contract).  Requires
+    stop_when_done=False (the cached program has no early-exit variant)
+    and is mutually exclusive with checkpoint_dir."""
     from ..engine.core import replicate_state
     from ..faults import FaultConfig
-    from ..faults.plan import lower_plans
+    from ..faults.plan import fault_state_digest
+    from ..faults.state import neutral_fault_state, stack_fault_states
 
     if not plans:
         raise ValueError("run_fault_sweep needs at least one plan")
     rpp = int(replicas_per_plan)
     if rpp < 1:
         raise ValueError(f"replicas_per_plan={rpp} must be >= 1")
+    if use_run_cache and stop_when_done:
+        raise ValueError(
+            "use_run_cache evaluates a fixed-horizon cached program; "
+            "stop_when_done is not supported on that path"
+        )
+    if use_run_cache and checkpoint_dir is not None:
+        raise ValueError(
+            "use_run_cache and checkpoint_dir are mutually exclusive "
+            "(the resumable path runs chunked under the Supervisor)"
+        )
     fnet, fstate = net.with_faults(state, faults or FaultConfig())
-    n_rep = len(plans) * rpp
-    fs = lower_plans(
-        [p for p in plans for _ in range(rpp)],
-        net.n_nodes,
-        net.protocol.n_msg_types(),
+    n_nodes, n_mt = net.n_nodes, net.protocol.n_msg_types()
+    lowered = [
+        neutral_fault_state(n_nodes, n_mt)
+        if p is None
+        else p.lower(n_nodes, n_mt)
+        for p in plans
+    ]
+    digests = [fault_state_digest(low) for low in lowered]
+    # dedupe by digest, first occurrence wins (keeps seeds/rows bitwise
+    # identical to the pre-dedupe sweep whenever all plans are distinct)
+    unique_pos: Dict[str, int] = {}
+    fan: List[int] = []
+    for i, dig in enumerate(digests):
+        if dig not in unique_pos:
+            unique_pos[dig] = len(unique_pos)
+        fan.append(unique_pos[dig])
+    n_unique = len(unique_pos)
+    SWEEP_COUNTERS["plans_in"] += len(plans)
+    SWEEP_COUNTERS["plans_evaluated"] += n_unique
+    SWEEP_COUNTERS["plans_deduped"] += len(plans) - n_unique
+    first_of = {u: i for i, u in reversed(list(enumerate(fan)))}
+    n_rep = n_unique * rpp
+    fs = stack_fault_states(
+        [lowered[first_of[u]] for u in range(n_unique) for _ in range(rpp)]
     )
     batched = replicate_state(
         fstate, n_rep, seeds=np.arange(seed0, seed0 + n_rep, dtype=np.int64)
@@ -278,6 +344,10 @@ def run_fault_sweep(
                 report=report,
             )
         out = report.state
+    elif use_run_cache:
+        from ..parallel.replica_shard import sharded_run_stats
+
+        out, _ = sharded_run_stats(fnet, batched, sim_ms)
     else:
         out = fnet.run_ms_batched(batched, sim_ms, stop_when_done)
 
@@ -287,7 +357,8 @@ def run_fault_sweep(
     delayed = np.asarray(out.faults.delayed_by_fault)
     records = []
     for i, plan in enumerate(plans):
-        sl = slice(i * rpp, (i + 1) * rpp)
+        u = fan[i]
+        sl = slice(u * rpp, (u + 1) * rpp)
         live = ~down[sl]
         d = done[sl][live]
         fin = d[d > 0]
@@ -295,6 +366,8 @@ def run_fault_sweep(
             "plan": (
                 {"label": "control"} if plan is None else plan.describe()
             ),
+            "plan_digest": digests[i],
+            "seed0_row": int(seed0 + u * rpp),
             "replicas": rpp,
             "live_nodes": int(live.sum()),
             "done_nodes": int(fin.size),

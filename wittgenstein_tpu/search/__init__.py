@@ -1,0 +1,65 @@
+"""Adversary search: batched black-box optimization over FaultPlan space.
+
+The fault engine made per-replica schedules DATA (faults/state.py), and
+`scenarios.sweep.run_fault_sweep` already evaluates a heterogeneous list
+of FaultPlans in one `run_ms_batched` program — a free population
+evaluator.  This package closes the loop: a bounded genome lowers to a
+FaultPlan (genome.py), per-protocol scalar objectives read the sweep
+records (objectives.py), and batched optimizers — seeded random search,
+a (μ,λ) diagonal-covariance ES, a successive-halving bandit — spend one
+`run_fault_sweep` call per generation (optimizers.py, driver.py), so a
+whole search campaign costs ONE compile after warm-up.  Discovered
+attacks are pinned as replayable regression scenarios
+(`scenarios/regressions/*.json`, audited by simlint SL1401).  See
+docs/search.md.
+
+Import discipline: genome/objectives/optimizers are numpy-only at
+module import (simlint's fast pass loads them without JAX); anything
+that lowers plans or runs the engine imports lazily.
+"""
+
+from .driver import (
+    SEARCH_COUNTERS,
+    SearchConfig,
+    SearchDriver,
+    baseline_scores,
+    optimize_env_policy,
+    search_metrics,
+    static_baseline_plans,
+)
+from .genome import FaultGenome, GeneSpec, GenomeSpec
+from .objectives import (
+    OBJECTIVES,
+    Objective,
+    get_objective,
+    pareto_frontier,
+    score_records,
+)
+from .optimizers import (
+    EvolutionStrategy,
+    RandomSearch,
+    SuccessiveHalving,
+    make_optimizer,
+)
+
+__all__ = [
+    "EvolutionStrategy",
+    "FaultGenome",
+    "GeneSpec",
+    "GenomeSpec",
+    "OBJECTIVES",
+    "Objective",
+    "RandomSearch",
+    "SEARCH_COUNTERS",
+    "SearchConfig",
+    "SearchDriver",
+    "SuccessiveHalving",
+    "baseline_scores",
+    "get_objective",
+    "make_optimizer",
+    "optimize_env_policy",
+    "pareto_frontier",
+    "score_records",
+    "search_metrics",
+    "static_baseline_plans",
+]

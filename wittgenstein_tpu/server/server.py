@@ -227,6 +227,23 @@ class Server:
         except Exception:
             pass
         try:
+            from ..search.driver import search_metrics
+
+            sm = search_metrics()
+            p.add("search_generations_total", sm["generations_total"],
+                  "adversary-search generations evaluated", "counter")
+            p.add("search_evals_total", sm["evals_total"],
+                  "adversary-search replica rows evaluated", "counter")
+            p.add("search_eval_seconds_total",
+                  round(sm["eval_seconds_total"], 3),
+                  "wall-clock spent in adversary-search sweeps", "counter")
+            p.add("search_pinned_total", sm["pinned_total"],
+                  "champions pinned as regression scenarios", "counter")
+            p.add("search_best_objective", sm["best_objective"],
+                  "last champion objective value seen", "gauge")
+        except Exception:
+            pass
+        try:
             from ..profiling.probe import add_probe_metrics
 
             add_probe_metrics(p)
